@@ -1,0 +1,111 @@
+"""Headline benchmark: GPT-2-small SPMD training throughput per chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/s/chip", "vs_baseline": N}
+
+Baseline: the reference's flagship Train config is "TorchTrainer
+GPT-2-small DDP" (BASELINE.json). No per-chip token throughput is
+archived in the reference's release logs, so we use a nominal
+NCCL/GPU-era DDP figure of 30,000 tokens/s per accelerator for
+GPT-2-small (bf16, torch DDP on A100-class hardware, nanoGPT-style
+measurement) as vs_baseline=1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+BASELINE_TOKENS_PER_SEC_PER_CHIP = 30_000.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models.gpt2 import (
+        GPT2Config,
+        count_params,
+        gpt2_loss,
+        gpt2_partition_rules,
+        init_gpt2,
+    )
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+    from ray_tpu.train.spmd import (
+        batch_shardings,
+        init_sharded_state,
+        make_train_step,
+    )
+
+    devices = jax.devices()
+    n = len(devices)
+    on_tpu = devices[0].platform not in ("cpu",)
+
+    if on_tpu:
+        cfg = GPT2Config.small()
+        batch_per_chip, seq = 8, 1024
+        steps, warmup = 20, 3
+    else:  # CPU smoke path so bench.py always emits a line
+        cfg = GPT2Config.tiny()
+        batch_per_chip, seq = 4, 128
+        steps, warmup = 5, 2
+
+    mesh = build_mesh(MeshSpec(data=-1), devices=devices)
+    rules = gpt2_partition_rules()
+    tx = optax.adamw(3e-4, weight_decay=0.1)
+    state = init_sharded_state(
+        lambda: init_gpt2(jax.random.PRNGKey(0), cfg), tx, mesh, rules
+    )
+    n_params = count_params(state.params)
+
+    B = batch_per_chip * n
+    toks = jax.random.randint(
+        jax.random.PRNGKey(1), (B, seq + 1), 0, cfg.vocab_size, jnp.int32
+    )
+    batch = {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+    batch = jax.device_put(batch, batch_shardings(mesh, batch))
+
+    step = make_train_step(lambda p, b: gpt2_loss(p, b, cfg), tx)
+    with mesh:
+        for _ in range(warmup):
+            state, metrics = step(state, batch)
+        # device-to-host copy as the sync point: block_until_ready is not
+        # a reliable barrier on every PJRT plugin
+        float(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, batch)
+        final_loss = float(metrics["loss"])
+        dt = time.perf_counter() - t0
+
+    tokens_per_sec = B * seq * steps / dt
+    per_chip = tokens_per_sec / n
+    # MFU against v5e peak 197 TFLOP/s bf16 (fwd+bwd ~ 6*N flops/token)
+    mfu = 6.0 * n_params * per_chip / 197e12 if on_tpu else 0.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "gpt2_small_train_tokens_per_sec_per_chip"
+                if on_tpu
+                else "gpt2_tiny_cpu_smoke_tokens_per_sec_per_chip",
+                "value": round(per_chip, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(per_chip / BASELINE_TOKENS_PER_SEC_PER_CHIP, 3),
+                "extra": {
+                    "n_chips": n,
+                    "params": n_params,
+                    "batch": B,
+                    "seq": seq,
+                    "step_ms": round(1e3 * dt / steps, 1),
+                    "mfu": round(mfu, 3),
+                    "loss": round(final_loss, 4),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

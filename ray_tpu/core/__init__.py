@@ -1,0 +1,14 @@
+"""ray_tpu.core — the task/actor/object runtime.
+
+Architecture (mirrors the reference's control/data-plane split,
+SURVEY.md §1; reference: src/ray/gcs, src/ray/raylet, src/ray/core_worker):
+
+- **Controller** (GCS equivalent): cluster membership, actor directory,
+  placement groups, KV store, pubsub, health checks.
+- **Nodelet** (raylet equivalent): per-node agent — local scheduler with
+  resource instances, worker pool, shared-memory object store.
+- **Worker**: task execution loop; every driver is also a worker.
+- Data plane is worker-to-worker: after a lease is granted by a nodelet,
+  tasks are pushed directly to the leased worker; the controller is not
+  on the task hot path.
+"""

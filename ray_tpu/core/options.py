@@ -1,0 +1,90 @@
+"""Validation of @remote(...) / .options(...) arguments.
+
+Reference: python/ray/_private/ray_option_utils.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass
+class TaskOptions:
+    num_cpus: float | None = None
+    num_tpus: float | None = None
+    resources: dict[str, float] = dataclasses.field(default_factory=dict)
+    num_returns: int = 1
+    max_retries: int = 3
+    retry_exceptions: bool | list = False
+    name: str | None = None
+    scheduling_strategy: Any = None
+    placement_group: Any = None
+    placement_group_bundle_index: int = -1
+    label_selector: dict[str, str] | None = None
+
+    def resource_request(self) -> dict[str, float]:
+        req = dict(self.resources)
+        req["CPU"] = self.num_cpus if self.num_cpus is not None else 1.0
+        if self.num_tpus:
+            req["TPU"] = self.num_tpus
+        return {k: v for k, v in req.items() if v}
+
+
+@dataclasses.dataclass
+class ActorOptions:
+    num_cpus: float | None = None
+    num_tpus: float | None = None
+    resources: dict[str, float] = dataclasses.field(default_factory=dict)
+    name: str | None = None
+    namespace: str | None = None
+    lifetime: str | None = None  # None | "detached"
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    max_pending_calls: int = -1
+    scheduling_strategy: Any = None
+    placement_group: Any = None
+    placement_group_bundle_index: int = -1
+    get_if_exists: bool = False
+    label_selector: dict[str, str] | None = None
+
+    def resource_request(self) -> dict[str, float]:
+        req = dict(self.resources)
+        # Actors default to 1 CPU for placement but 0 for running
+        # (reference semantics); we keep it simple: reserve what's asked,
+        # default 1 CPU.
+        req["CPU"] = self.num_cpus if self.num_cpus is not None else 1.0
+        if self.num_tpus:
+            req["TPU"] = self.num_tpus
+        return {k: v for k, v in req.items() if v}
+
+
+_TASK_KEYS = {f.name for f in dataclasses.fields(TaskOptions)}
+_ACTOR_KEYS = {f.name for f in dataclasses.fields(ActorOptions)}
+# accepted-but-ignored (compat shims, recorded for parity)
+_SOFT_KEYS = {"runtime_env", "memory", "accelerator_type", "num_gpus",
+              "_metadata", "enable_task_events", "concurrency_groups"}
+
+
+def task_options(d: dict) -> TaskOptions:
+    _check(d, _TASK_KEYS, "task")
+    if d.get("num_gpus"):
+        # GPU-shaped requests map onto the TPU resource on this framework.
+        d = dict(d)
+        d["num_tpus"] = d.pop("num_gpus")
+    return TaskOptions(**{k: v for k, v in d.items() if k in _TASK_KEYS})
+
+
+def actor_options(d: dict) -> ActorOptions:
+    _check(d, _ACTOR_KEYS, "actor")
+    if d.get("num_gpus"):
+        d = dict(d)
+        d["num_tpus"] = d.pop("num_gpus")
+    return ActorOptions(**{k: v for k, v in d.items() if k in _ACTOR_KEYS})
+
+
+def _check(d: dict, allowed: set, kind: str):
+    bad = set(d) - allowed - _SOFT_KEYS
+    if bad:
+        raise ValueError(f"invalid {kind} option(s): {sorted(bad)}")

@@ -1,0 +1,381 @@
+"""Runtime selection + the in-process LocalRuntime.
+
+`make_runtime` picks the backend for `ray_tpu.init()`:
+- `local_mode=True` → `LocalRuntime`: threads in this process, full API
+  semantics (the semantic reference for the distributed runtime; cf.
+  reference local mode).
+- otherwise → `ClusterRuntime` (ray_tpu.core.cluster_runtime): boots or
+  connects to a controller + nodelets + worker processes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue as _queue
+import threading
+import time
+import traceback
+from typing import Any, Callable
+
+from ray_tpu.core import exceptions as exc
+from ray_tpu.core.api import ActorHandle, ObjectRef
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.options import ActorOptions, TaskOptions
+from ray_tpu.utils.events import TaskEventLog
+
+
+def make_runtime(address=None, local_mode=False, **kwargs):
+    if local_mode:
+        return LocalRuntime(**kwargs)
+    from ray_tpu.core.cluster_runtime import ClusterRuntime
+
+    return ClusterRuntime(address=address, **kwargs)
+
+
+# ---------------------------------------------------------------- slots
+
+
+class _Slot:
+    __slots__ = ("event", "value", "error", "cancelled")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value = None
+        self.error: BaseException | None = None
+        self.cancelled = False
+
+    def set_value(self, v):
+        self.value = v
+        self.event.set()
+
+    def set_error(self, e: BaseException):
+        self.error = e
+        self.event.set()
+
+
+@dataclasses.dataclass
+class _LocalActor:
+    actor_id: ActorID
+    cls: type
+    args: tuple
+    kwargs: dict
+    opts: ActorOptions
+    inbox: _queue.Queue = dataclasses.field(default_factory=_queue.Queue)
+    instance: Any = None
+    dead: bool = False
+    death_cause: str = ""
+    restarts_left: int = 0
+    threads: list = dataclasses.field(default_factory=list)
+
+
+class _Context(threading.local):
+    def __init__(self):
+        self.actor_id: ActorID | None = None
+        self.task_id: TaskID | None = None
+
+
+class LocalRuntime:
+    """Whole-cluster semantics in one process. Tasks run on daemon
+    threads; actors get dedicated ordered-execution threads."""
+
+    def __init__(self, num_cpus=None, num_tpus=None, resources=None,
+                 namespace=None, labels=None, **_):
+        self.job_id = JobID.random()
+        self.node_id = NodeID.random()
+        self.worker_id = WorkerID.random()
+        self.namespace = namespace or "default"
+        self._objects: dict[ObjectID, _Slot] = {}
+        self._objects_lock = threading.Lock()
+        self._actors: dict[ActorID, _LocalActor] = {}
+        self._named: dict[tuple[str, str], ActorID] = {}
+        self._actors_lock = threading.Lock()
+        self._ctx = _Context()
+        self._events = TaskEventLog()
+        self._resources = dict(resources or {})
+        self._resources.setdefault("CPU", num_cpus if num_cpus is not None else 8)
+        if num_tpus:
+            self._resources["TPU"] = num_tpus
+        self._shutdown = False
+
+    # ------------------------------------------------------------ objects
+
+    def _slot(self, oid: ObjectID) -> _Slot:
+        with self._objects_lock:
+            s = self._objects.get(oid)
+            if s is None:
+                s = self._objects[oid] = _Slot()
+            return s
+
+    def put(self, value) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("put() of an ObjectRef is not allowed")
+        oid = ObjectID.random()
+        self._slot(oid).set_value(value)
+        return ObjectRef(oid)
+
+    def get(self, refs: list[ObjectRef], timeout=None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for r in refs:
+            s = self._slot(r.id)
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            if not s.event.wait(remaining):
+                raise exc.GetTimeoutError(f"get() timed out waiting for {r}")
+            if s.error is not None:
+                raise s.error
+            out.append(s.value)
+        return out
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready, not_ready = [], list(refs)
+        while True:
+            still = []
+            for r in not_ready:
+                if self._slot(r.id).event.is_set():
+                    ready.append(r)
+                else:
+                    still.append(r)
+            not_ready = still
+            if len(ready) >= num_returns or not not_ready:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.001)
+        return ready, not_ready
+
+    def as_future(self, ref: ObjectRef):
+        import concurrent.futures as cf
+
+        fut = cf.Future()
+        s = self._slot(ref.id)
+
+        def waiter():
+            s.event.wait()
+            if s.error is not None:
+                fut.set_exception(s.error)
+            else:
+                fut.set_result(s.value)
+
+        threading.Thread(target=waiter, daemon=True).start()
+        return fut
+
+    def _resolve_args(self, args, kwargs):
+        def resolve(v):
+            if isinstance(v, ObjectRef):
+                return self.get([v])[0]
+            return v
+
+        return tuple(resolve(a) for a in args), {k: resolve(v) for k, v in kwargs.items()}
+
+    # ------------------------------------------------------------ tasks
+
+    def submit_task(self, fn: Callable, args, kwargs, opts: TaskOptions):
+        n = opts.num_returns
+        oids = [ObjectID.random() for _ in range(n)]
+        slots = [self._slot(o) for o in oids]
+        task_id = TaskID.random()
+        name = opts.name or fn.__name__
+
+        def run():
+            self._ctx.task_id = task_id
+            tries = opts.max_retries + 1 if opts.retry_exceptions else 1
+            with self._events.span(name, "task"):
+                for attempt in range(max(1, tries)):
+                    if slots[0].cancelled:
+                        for s in slots:
+                            s.set_error(exc.TaskCancelledError(name))
+                        return
+                    try:
+                        a, kw = self._resolve_args(args, kwargs)
+                        result = fn(*a, **kw)
+                        if n == 1:
+                            slots[0].set_value(result)
+                        else:
+                            vals = list(result)
+                            if len(vals) != n:
+                                raise ValueError(
+                                    f"task {name} returned {len(vals)} values, "
+                                    f"expected num_returns={n}"
+                                )
+                            for s, v in zip(slots, vals):
+                                s.set_value(v)
+                        return
+                    except Exception as e:  # noqa: BLE001
+                        if attempt + 1 < tries and _should_retry(e, opts.retry_exceptions):
+                            continue
+                        err = exc.TaskError.from_exception(e, name)
+                        for s in slots:
+                            s.set_error(err)
+                        return
+
+        threading.Thread(target=run, daemon=True, name=f"task-{name}").start()
+        refs = [ObjectRef(o) for o in oids]
+        return refs[0] if n == 1 else refs
+
+    def cancel(self, ref: ObjectRef, force=False, recursive=True):
+        self._slot(ref.id).cancelled = True
+
+    # ------------------------------------------------------------ actors
+
+    def create_actor(self, cls, args, kwargs, opts: ActorOptions) -> ActorHandle:
+        with self._actors_lock:
+            if opts.name:
+                key = (opts.namespace or self.namespace, opts.name)
+                if key in self._named:
+                    if opts.get_if_exists:
+                        return self._handle(self._actors[self._named[key]])
+                    raise ValueError(f"actor name {opts.name!r} already taken")
+        actor = _LocalActor(
+            actor_id=ActorID.random(),
+            cls=cls,
+            args=args,
+            kwargs=kwargs,
+            opts=opts,
+            restarts_left=opts.max_restarts,
+        )
+        with self._actors_lock:
+            self._actors[actor.actor_id] = actor
+            if opts.name:
+                self._named[(opts.namespace or self.namespace, opts.name)] = actor.actor_id
+        for i in range(max(1, opts.max_concurrency)):
+            t = threading.Thread(
+                target=self._actor_loop, args=(actor,), daemon=True,
+                name=f"actor-{cls.__name__}-{i}",
+            )
+            actor.threads.append(t)
+            t.start()
+        return self._handle(actor)
+
+    def _handle(self, actor: _LocalActor) -> ActorHandle:
+        meta = {}
+        for mname in dir(actor.cls):
+            m = getattr(actor.cls, mname, None)
+            if callable(m) and hasattr(m, "__ray_tpu_method_options__"):
+                meta[mname] = m.__ray_tpu_method_options__
+        return ActorHandle(actor.actor_id, meta)
+
+    def _actor_loop(self, actor: _LocalActor):
+        self._ctx.actor_id = actor.actor_id
+        if actor.instance is None and not actor.dead:
+            try:
+                a, kw = self._resolve_args(actor.args, actor.kwargs)
+                actor.instance = actor.cls(*a, **kw)
+            except Exception as e:  # noqa: BLE001
+                actor.dead = True
+                actor.death_cause = f"__init__ failed: {e}\n{traceback.format_exc()}"
+        while not actor.dead and not self._shutdown:
+            try:
+                item = actor.inbox.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+            if item is None:
+                break
+            mname, args, kwargs, slots = item
+            with self._events.span(f"{actor.cls.__name__}.{mname}", "actor_task"):
+                try:
+                    a, kw = self._resolve_args(args, kwargs)
+                    fn = getattr(actor.instance, mname)
+                    result = fn(*a, **kw)
+                    if len(slots) == 1:
+                        slots[0].set_value(result)
+                    else:
+                        for s, v in zip(slots, list(result)):
+                            s.set_value(v)
+                except Exception as e:  # noqa: BLE001
+                    err = exc.TaskError.from_exception(e, f"{actor.cls.__name__}.{mname}")
+                    for s in slots:
+                        s.set_error(err)
+
+    def submit_actor_task(self, actor_id: ActorID, mname: str, args, kwargs, mopts: dict):
+        with self._actors_lock:
+            actor = self._actors.get(actor_id)
+        if actor is None:
+            raise exc.ActorDiedError(f"no such actor {actor_id}")
+        n = int(mopts.get("num_returns", 1))
+        oids = [ObjectID.random() for _ in range(n)]
+        slots = [self._slot(o) for o in oids]
+        if actor.dead:
+            for s in slots:
+                s.set_error(exc.ActorDiedError(actor.death_cause or "actor is dead"))
+        else:
+            actor.inbox.put((mname, args, kwargs, slots))
+        refs = [ObjectRef(o) for o in oids]
+        return refs[0] if n == 1 else refs
+
+    def kill_actor(self, actor_id: ActorID, no_restart=True):
+        with self._actors_lock:
+            actor = self._actors.get(actor_id)
+        if actor is None:
+            return
+        actor.dead = True
+        actor.death_cause = "killed via ray_tpu.kill()"
+        # drain pending calls with ActorDiedError
+        try:
+            while True:
+                item = actor.inbox.get_nowait()
+                if item:
+                    for s in item[3]:
+                        s.set_error(exc.ActorDiedError(actor.death_cause))
+        except _queue.Empty:
+            pass
+
+    def get_named_actor(self, name: str, namespace=None) -> ActorHandle:
+        key = (namespace or self.namespace, name)
+        with self._actors_lock:
+            aid = self._named.get(key)
+            if aid is None or self._actors[aid].dead:
+                raise ValueError(f"no live actor named {name!r}")
+            return self._handle(self._actors[aid])
+
+    # ------------------------------------------------------------ cluster
+
+    def nodes(self):
+        return [
+            {
+                "NodeID": self.node_id.hex(),
+                "Alive": True,
+                "Resources": dict(self._resources),
+                "Labels": {},
+                "NodeManagerAddress": "127.0.0.1",
+            }
+        ]
+
+    def cluster_resources(self):
+        return dict(self._resources)
+
+    def available_resources(self):
+        return dict(self._resources)
+
+    def runtime_context(self):
+        from ray_tpu.core.runtime_context import RuntimeContext
+
+        return RuntimeContext(
+            job_id=self.job_id,
+            node_id=self.node_id,
+            worker_id=self.worker_id,
+            actor_id=self._ctx.actor_id,
+            task_id=self._ctx.task_id,
+            namespace=self.namespace,
+        )
+
+    def timeline(self, filename=None):
+        return self._events.chrome_trace(filename)
+
+    def context_info(self):
+        return {"node_id": self.node_id.hex(), "local_mode": True}
+
+    def shutdown(self):
+        self._shutdown = True
+        with self._actors_lock:
+            for a in self._actors.values():
+                a.dead = True
+                a.inbox.put(None)
+
+
+def _should_retry(e: BaseException, retry_exceptions) -> bool:
+    if retry_exceptions is True:
+        return True
+    if isinstance(retry_exceptions, (list, tuple)):
+        return isinstance(e, tuple(retry_exceptions))
+    return False

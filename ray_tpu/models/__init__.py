@@ -1,0 +1,5 @@
+"""Model zoo for the TPU-native framework (pure-JAX, mesh-shardable)."""
+
+from ray_tpu.models.gpt2 import GPT2Config, gpt2_partition_rules, init_gpt2, gpt2_forward
+
+__all__ = ["GPT2Config", "gpt2_partition_rules", "init_gpt2", "gpt2_forward"]

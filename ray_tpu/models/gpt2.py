@@ -1,0 +1,214 @@
+"""GPT-2 in pure JAX, designed for mesh sharding.
+
+This is the flagship Train model (reference benchmark: "TorchTrainer
+GPT-2-small DDP", BASELINE.json). TPU-first design decisions:
+
+- transformer blocks are *stacked* along a leading layer axis and executed
+  with `lax.scan`: one compiled block body regardless of depth (fast
+  compiles, XLA-friendly), instead of a Python loop of modules,
+- parameters are a plain nested-dict pytree with declarative partition
+  rules (ray_tpu.parallel.sharding) covering data/fsdp/tensor axes:
+  Megatron-style column->row sharding inside attention and the MLP so the
+  only tensor-axis collective per block is one psum (inserted by GSPMD),
+- activations carry sharding constraints on the batch (data+fsdp) and
+  hidden (tensor) dimensions,
+- compute dtype bfloat16 (MXU-native), params float32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel.sharding import PartitionRules, constrain
+from ray_tpu.ops.attention import causal_attention
+
+Params = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_layer: int = 12
+    n_head: int = 12
+    n_embd: int = 768
+    block_size: int = 1024
+    dtype: Any = jnp.bfloat16
+    # Pad the vocab so the logits matmul tiles cleanly onto the MXU and
+    # shards evenly over the tensor axis (50257 -> 50304 for gpt2-small).
+    vocab_pad_multiple: int = 128
+    remat: bool = True
+
+    @property
+    def padded_vocab(self) -> int:
+        m = self.vocab_pad_multiple
+        return (self.vocab_size + m - 1) // m * m
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    @staticmethod
+    def small() -> "GPT2Config":
+        return GPT2Config()
+
+    @staticmethod
+    def tiny(vocab_size: int = 512, block_size: int = 128) -> "GPT2Config":
+        return GPT2Config(
+            vocab_size=vocab_size,
+            n_layer=2,
+            n_head=4,
+            n_embd=128,
+            block_size=block_size,
+            vocab_pad_multiple=128,
+        )
+
+
+def gpt2_partition_rules() -> PartitionRules:
+    """Megatron-style sharding. Stacked block params have a leading layer
+    dim (None). Column-parallel: qkv / mlp fc shard output dim on
+    'tensor'; row-parallel: attn proj / mlp proj shard input dim on
+    'tensor'. 'fsdp' shards the other matmul dim (ZeRO-3-style)."""
+    return PartitionRules(
+        [
+            (r"wte$", P("tensor", "fsdp")),
+            (r"wpe$", P(None, "fsdp")),
+            (r"attn_qkv/kernel$", P(None, "fsdp", "tensor")),
+            (r"attn_proj/kernel$", P(None, "tensor", "fsdp")),
+            (r"mlp_fc/kernel$", P(None, "fsdp", "tensor")),
+            (r"mlp_proj/kernel$", P(None, "tensor", "fsdp")),
+            (r"attn_qkv/bias$", P(None, "tensor")),
+            (r"mlp_fc/bias$", P(None, "tensor")),
+            # layer norms, row-parallel biases: replicated
+            (r".*", P()),
+        ]
+    )
+
+
+def _dense_init(key, in_dim, out_dim, scale):
+    return jax.random.normal(key, (in_dim, out_dim), jnp.float32) * scale
+
+
+def init_gpt2(key: jax.Array, cfg: GPT2Config) -> Params:
+    """Initialize parameters (float32 master copy), GPT-2 init scheme:
+    normal(0.02), residual projections scaled by 1/sqrt(2*n_layer)."""
+    k = jax.random.split(key, 8)
+    L, E, V = cfg.n_layer, cfg.n_embd, cfg.padded_vocab
+    std = 0.02
+    resid_std = 0.02 / math.sqrt(2 * cfg.n_layer)
+
+    def stack(idx, initializer):
+        keys = jax.random.split(jax.random.fold_in(k[7], idx), L)
+        return jnp.stack([initializer(keys[i]) for i in range(L)])
+
+    def qkv(kk):
+        return _dense_init(kk, E, 3 * E, std)
+
+    def attn_proj(kk):
+        return _dense_init(kk, E, E, resid_std)
+
+    def mlp_fc(kk):
+        return _dense_init(kk, E, 4 * E, std)
+
+    def mlp_proj(kk):
+        return _dense_init(kk, 4 * E, E, resid_std)
+
+    blocks = {
+        "ln1": {"scale": jnp.ones((L, E)), "bias": jnp.zeros((L, E))},
+        "attn_qkv": {"kernel": stack(0, qkv), "bias": jnp.zeros((L, 3 * E))},
+        "attn_proj": {"kernel": stack(1, attn_proj), "bias": jnp.zeros((L, E))},
+        "ln2": {"scale": jnp.ones((L, E)), "bias": jnp.zeros((L, E))},
+        "mlp_fc": {"kernel": stack(2, mlp_fc), "bias": jnp.zeros((L, 4 * E))},
+        "mlp_proj": {"kernel": stack(3, mlp_proj), "bias": jnp.zeros((L, E))},
+    }
+    return {
+        "wte": jax.random.normal(k[0], (V, E), jnp.float32) * std,
+        "wpe": jax.random.normal(k[1], (cfg.block_size, E), jnp.float32) * std,
+        "blocks": blocks,
+        "lnf": {"scale": jnp.ones((E,)), "bias": jnp.zeros((E,))},
+    }
+
+
+def _layer_norm(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _block(x, p, cfg: GPT2Config):
+    """One transformer block. `p` holds this layer's (unstacked) params."""
+    B, T, E = x.shape
+    dt = cfg.dtype
+    h = _layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"])
+    qkv = h @ p["attn_qkv"]["kernel"].astype(dt) + p["attn_qkv"]["bias"].astype(dt)
+    qkv = constrain(qkv, ("data", "fsdp"), None, "tensor")
+    q, kk, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(B, T, cfg.n_head, cfg.head_dim)
+
+    att = causal_attention(heads(q), heads(kk), heads(v))
+    att = att.reshape(B, T, E)
+    att = att @ p["attn_proj"]["kernel"].astype(dt) + p["attn_proj"]["bias"].astype(dt)
+    x = x + constrain(att, ("data", "fsdp"), None, None)
+
+    h = _layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+    h = h @ p["mlp_fc"]["kernel"].astype(dt) + p["mlp_fc"]["bias"].astype(dt)
+    h = constrain(h, ("data", "fsdp"), None, "tensor")
+    h = jax.nn.gelu(h)
+    h = h @ p["mlp_proj"]["kernel"].astype(dt) + p["mlp_proj"]["bias"].astype(dt)
+    x = x + constrain(h, ("data", "fsdp"), None, None)
+    return x
+
+
+def gpt2_forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """tokens (B, T) int32 -> logits (B, T, padded_vocab) float32."""
+    B, T = tokens.shape
+    dt = cfg.dtype
+    # The embedding table is vocab-sharded over 'tensor' (for the logits
+    # matmul); a sharded gather would force XLA into an involuntary full
+    # rematerialization, so explicitly all-gather it before the lookup
+    # (it is small next to activations, and the transposed scatter-add in
+    # backward then reduces cleanly).
+    wte = constrain(params["wte"].astype(dt), None, None)
+    x = wte[tokens] + params["wpe"].astype(dt)[:T]
+    x = constrain(x, ("data", "fsdp"), None, None)
+
+    block = _block
+    if cfg.remat:
+        block = jax.checkpoint(_block, static_argnums=(2,))
+
+    def body(carry, layer_params):
+        return block(carry, layer_params, cfg), None
+
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    x = _layer_norm(x, params["lnf"]["scale"], params["lnf"]["bias"])
+    logits = x @ params["wte"].astype(dt).T
+    logits = constrain(logits, ("data", "fsdp"), None, "tensor")
+    return logits.astype(jnp.float32)
+
+
+def gpt2_loss(params: Params, batch: dict, cfg: GPT2Config) -> jax.Array:
+    """Next-token cross entropy; positions past vocab_size are masked."""
+    logits = gpt2_forward(params, batch["tokens"], cfg)
+    targets = batch["targets"]
+    V = cfg.padded_vocab
+    mask = jnp.arange(V) < cfg.vocab_size
+    logits = jnp.where(mask, logits, -1e9)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    weights = batch.get("weights")
+    if weights is None:
+        return -jnp.mean(ll)
+    return -jnp.sum(ll * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+
+
+def count_params(params: Params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))

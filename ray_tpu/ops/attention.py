@@ -1,0 +1,57 @@
+"""Causal multi-head attention.
+
+Two paths:
+- `causal_attention_reference`: plain jnp einsum formulation — XLA fuses
+  this well and it runs on any backend (CPU tests, interpret mode).
+- `flash_attention`: pallas TPU kernel (ray_tpu.ops.flash_attention) with
+  online softmax and block-sparse causal masking, used automatically on
+  TPU for long sequences.
+
+Softmax statistics are computed in float32 regardless of input dtype
+(bfloat16 accumulation loses too much precision on long sequences).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# Sequence length at or above which the pallas kernel pays for itself.
+_FLASH_MIN_SEQ = 512
+
+
+def causal_attention_reference(
+    q: jax.Array, k: jax.Array, v: jax.Array
+) -> jax.Array:
+    """q,k,v: (B, T, H, D) -> (B, T, H, D), causal."""
+    B, T, H, D = q.shape
+    scale = 1.0 / (D**0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Dispatch: pallas flash kernel on TPU for long sequences, reference
+    einsum elsewhere."""
+    T = q.shape[1]
+    if T >= _FLASH_MIN_SEQ and _on_tpu():
+        try:
+            from ray_tpu.ops.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=True)
+        except Exception:
+            pass
+    return causal_attention_reference(q, k, v)
+
+
+@functools.cache
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False

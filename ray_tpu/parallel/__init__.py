@@ -1,0 +1,41 @@
+"""TPU-native parallelism layer.
+
+Replaces the reference's NCCL/Gloo worlds (python/ray/util/collective/,
+python/ray/train/torch/config.py:66-124) with SPMD over jax device meshes:
+mesh construction from TPU slice topology, partition-rule based sharding,
+and a collective API that lowers to XLA ICI/DCN primitives.
+"""
+
+from ray_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_PIPE,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+    MeshSpec,
+    build_mesh,
+    local_mesh,
+)
+from ray_tpu.parallel.sharding import (
+    PartitionRules,
+    named_sharding_tree,
+    shard_pytree,
+    spec_for_path,
+)
+
+__all__ = [
+    "AXIS_DATA",
+    "AXIS_FSDP",
+    "AXIS_TENSOR",
+    "AXIS_SEQ",
+    "AXIS_EXPERT",
+    "AXIS_PIPE",
+    "MeshSpec",
+    "build_mesh",
+    "local_mesh",
+    "PartitionRules",
+    "named_sharding_tree",
+    "shard_pytree",
+    "spec_for_path",
+]

@@ -1,0 +1,127 @@
+"""Partition-rule based sharding for parameter pytrees.
+
+The reference delegates parameter layout to torch DDP/FSDP wrappers
+(ray/train/torch/train_loop_utils.py:162,179-183). The TPU-native
+formulation is declarative: a model ships an ordered list of
+(path-regex -> PartitionSpec) rules; we map them over the param pytree to
+NamedShardings and let GSPMD insert the collectives.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+PyTree = Any
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+class PartitionRules:
+    """Ordered (regex, PartitionSpec) rules; first match wins.
+
+    Specs may name axes that a given mesh doesn't have — those axis names
+    are dropped at resolution time, so one rule set serves every mesh
+    shape (a tensor='absent' mesh simply replicates that dimension).
+    """
+
+    def __init__(self, rules: Sequence[tuple[str, PartitionSpec]]):
+        self._rules = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(self, path: str, mesh: Mesh | None = None) -> PartitionSpec:
+        for pat, spec in self._rules:
+            if pat.search(path):
+                return _prune_spec(spec, mesh) if mesh is not None else spec
+        return PartitionSpec()
+
+    def shardings(self, tree: PyTree, mesh: Mesh) -> PyTree:
+        return jax.tree_util.tree_map_with_path(
+            lambda path, _: NamedSharding(
+                mesh, self.spec_for(_path_str(path), mesh)
+            ),
+            tree,
+        )
+
+    def specs(self, tree: PyTree, mesh: Mesh | None = None) -> PyTree:
+        return jax.tree_util.tree_map_with_path(
+            lambda path, _: self.spec_for(_path_str(path), mesh), tree
+        )
+
+
+def _prune_spec(spec: PartitionSpec, mesh) -> PartitionSpec:
+    """Drop axis names not present in (or of size 1 in) the mesh.
+
+    Works for both concrete `Mesh` and `AbstractMesh` (whose .shape is a
+    name->size mapping).
+    """
+    shape = dict(mesh.shape)
+    have = {n for n, s in shape.items() if s > 1}
+
+    def prune(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in have)
+            return kept if kept else None
+        return entry if entry in have else None
+
+    return PartitionSpec(*(prune(e) for e in spec))
+
+
+def spec_for_path(rules: PartitionRules, path: str, mesh: Mesh | None = None):
+    return rules.spec_for(path, mesh)
+
+
+def named_sharding_tree(rules: PartitionRules, tree: PyTree, mesh: Mesh) -> PyTree:
+    return rules.shardings(tree, mesh)
+
+
+def shard_pytree(tree: PyTree, rules: PartitionRules, mesh: Mesh) -> PyTree:
+    """Device-put `tree` with shardings derived from `rules`."""
+    shardings = rules.shardings(tree, mesh)
+    return jax.device_put(tree, shardings)
+
+
+def constrain(x: jax.Array, *spec_entries) -> jax.Array:
+    """with_sharding_constraint that tolerates axes missing from the
+    ambient mesh (so model code can always write the full logical spec)."""
+    mesh = _current_mesh()
+    if mesh is None:
+        return x
+    spec = _prune_spec(PartitionSpec(*spec_entries), mesh)
+    if isinstance(mesh, Mesh):
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def _current_mesh():
+    """The ambient mesh, if model code runs under `jax.sharding.use_mesh`
+    (or a `with mesh:` block); None otherwise (single-device paths)."""
+    try:
+        env = jax.sharding.get_abstract_mesh()
+        if env is not None and env.axis_names:
+            return env
+    except Exception:
+        pass
+    try:
+        m = jax.interpreters.pxla.thread_resources.env.physical_mesh
+        if m.axis_names:
+            return m
+    except Exception:
+        pass
+    return None

@@ -1,0 +1,12 @@
+"""ray_tpu.train — distributed training library.
+
+TPU-native counterpart of ray.train (python/ray/train/): instead of N
+one-GPU workers forming an NCCL world via `dist.init_process_group`
+(train/torch/config.py:66-124), a training job is one SPMD program jitted
+over a device mesh; the worker group exists for multi-host process
+orchestration, data loading, and fault handling.
+"""
+
+from ray_tpu.train.spmd import TrainState, make_train_step, batch_shardings
+
+__all__ = ["TrainState", "make_train_step", "batch_shardings"]

@@ -1,0 +1,47 @@
+"""Task-event log → Chrome trace (reference: task events pipeline,
+core_worker/task_event_buffer.h → `ray timeline`)."""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+
+
+class TaskEventLog:
+    def __init__(self, capacity: int = 100_000):
+        self._events: list[dict] = []
+        self._lock = threading.Lock()
+        self._capacity = capacity
+
+    @contextlib.contextmanager
+    def span(self, name: str, category: str):
+        t0 = time.monotonic_ns()
+        tid = threading.get_ident()
+        try:
+            yield
+        finally:
+            t1 = time.monotonic_ns()
+            with self._lock:
+                if len(self._events) < self._capacity:
+                    self._events.append(
+                        {
+                            "name": name,
+                            "cat": category,
+                            "ph": "X",
+                            "ts": t0 / 1e3,
+                            "dur": (t1 - t0) / 1e3,
+                            "pid": 0,
+                            "tid": tid,
+                        }
+                    )
+
+    def chrome_trace(self, filename: str | None = None):
+        with self._lock:
+            events = list(self._events)
+        if filename:
+            with open(filename, "w") as f:
+                json.dump(events, f)
+            return filename
+        return events

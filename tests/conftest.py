@@ -1,0 +1,41 @@
+"""Test fixtures.
+
+Tests run on a virtual 8-device CPU mesh (SURVEY.md §4: the reference
+tests multi-node logic on one box with faked resources; we test
+multi-chip SPMD logic with faked devices). The axon TPU plugin in this
+image force-registers itself, so we must both set XLA_FLAGS before
+backend init and override jax_platforms via config (the env var alone is
+not enough).
+"""
+
+import os
+
+# Must happen before the first jax backend initialization.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_local():
+    """In-process local-mode runtime (reference fixture: ray_start_regular,
+    python/ray/tests/conftest.py:532)."""
+    import ray_tpu
+
+    ray_tpu.init(local_mode=True, num_cpus=8)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def cpu_mesh8():
+    """8-device mesh: data=2, fsdp=2, tensor=2."""
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    return build_mesh(MeshSpec(data=2, fsdp=2, tensor=2))

@@ -1,0 +1,40 @@
+import jax
+import pytest
+
+from ray_tpu.parallel.mesh import MeshSpec, build_mesh, slice_groups
+
+
+def test_mesh_spec_resolve_infer():
+    sizes = MeshSpec(data=-1, tensor=2).resolve(8)
+    assert sizes["data"] == 4 and sizes["tensor"] == 2
+
+
+def test_mesh_spec_resolve_exact():
+    sizes = MeshSpec(data=2, fsdp=2, tensor=2).resolve(8)
+    assert sizes["data"] * sizes["fsdp"] * sizes["tensor"] == 8
+
+
+def test_mesh_spec_mismatch_raises():
+    with pytest.raises(ValueError):
+        MeshSpec(data=3, tensor=3).resolve(8)
+
+
+def test_mesh_spec_two_unknown_raises():
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, tensor=-1).resolve(8)
+
+
+def test_build_mesh_canonical_order(cpu_mesh8):
+    names = cpu_mesh8.axis_names
+    assert names.index("data") < names.index("fsdp") < names.index("tensor")
+    assert dict(cpu_mesh8.shape)["data"] == 2
+
+
+def test_build_mesh_all_devices():
+    mesh = build_mesh(MeshSpec(data=-1))
+    assert dict(mesh.shape)["data"] == len(jax.devices())
+
+
+def test_slice_groups_cpu_single_domain():
+    groups = slice_groups()
+    assert sum(len(v) for v in groups.values()) == len(jax.devices())

@@ -1,0 +1,45 @@
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel.sharding import PartitionRules, shard_pytree
+
+
+RULES = PartitionRules(
+    [
+        (r"kernel$", P("fsdp", "tensor")),
+        (r"bias$", P("tensor")),
+        (r".*", P()),
+    ]
+)
+
+
+def test_first_match_wins():
+    rules = PartitionRules([(r"a/kernel$", P("tensor")), (r"kernel$", P("fsdp"))])
+    assert rules.spec_for("x/a/kernel") == P("tensor")
+    assert rules.spec_for("b/kernel") == P("fsdp")
+
+
+def test_no_match_replicates():
+    assert RULES.spec_for("whatever") == P()
+
+
+def test_prune_missing_axis(cpu_mesh8):
+    rules = PartitionRules([(r"k$", P("data", "nonexistent"))])
+    assert rules.spec_for("k", cpu_mesh8) == P("data", None)
+
+
+def test_prune_size_one_axis(cpu_mesh8):
+    # 'seq' exists in the mesh but has size 1 -> dropped
+    rules = PartitionRules([(r"k$", P("seq", "tensor"))])
+    assert rules.spec_for("k", cpu_mesh8) == P(None, "tensor")
+
+
+def test_shard_pytree(cpu_mesh8):
+    tree = {"layer": {"kernel": jnp.ones((8, 8)), "bias": jnp.ones((8,))}}
+    sharded = shard_pytree(tree, RULES, cpu_mesh8)
+    k = sharded["layer"]["kernel"]
+    assert k.sharding.spec == P("fsdp", "tensor")
+    assert sharded["layer"]["bias"].sharding.spec == P("tensor")
+    # round-trips values
+    assert jnp.allclose(jax.device_get(k), 1.0)
