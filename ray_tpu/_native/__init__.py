@@ -1,0 +1,37 @@
+"""Native (C++) components and their lazy build machinery.
+
+The reference ships its runtime as C++ compiled by bazel
+(src/ray/BUILD.bazel); here the native pieces are small, dependency-free
+C++ translation units compiled on first use with g++ and cached next to
+the source. A pure-Python fallback exists for every native component so
+the framework still works where no toolchain is present.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_LOCK = threading.Lock()
+
+
+def build_library(name: str) -> str | None:
+    """Compile `<name>.cc` → `lib<name>.so` (cached by mtime). Returns the
+    .so path, or None if no toolchain / compile failure."""
+    src = os.path.join(_HERE, f"{name}.cc")
+    out = os.path.join(_HERE, f"lib{name}.so")
+    with _BUILD_LOCK:
+        if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+            return out
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", out, src],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            return out
+        except Exception:
+            return None

@@ -1,0 +1,387 @@
+// Shared-memory object store: the node-local zero-copy object plane.
+//
+// Reference parity: plasma store (src/ray/object_manager/plasma/store.h:55,
+// plasma_allocator.h, eviction_policy.h). Re-designed rather than ported:
+// instead of a store *server* process with fd-passing over a unix socket
+// (plasma/fling.cc), every process on the node maps one named shm segment and
+// operates on it directly through this library under a process-shared lock.
+// That removes the store-server round trip from the create/get hot path
+// entirely — important because on a TPU host the store feeds jax.device_put
+// and the per-object control cost must be microseconds, not milliseconds.
+//
+// Layout of the segment:
+//   [Header][EntryTable (fixed capacity)][heap ...]
+// Allocator: first-fit over an offset-sorted free list with coalescing
+// (reference uses dlmalloc over mmap, plasma/dlmalloc.cc; first-fit+coalesce
+// is adequate because objects are large and few).
+// Eviction: LRU over sealed refcount==0 objects (plasma/eviction_policy.h).
+//
+// Concurrency: one process-shared spinlock in the header guards metadata.
+// Data copies happen outside the lock (offsets are stable once allocated).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+static const uint64_t kMagic = 0x52415954505553ULL;  // "RAYTPUS"
+static const uint64_t kAlign = 64;
+
+struct Header {
+  uint64_t magic;
+  uint64_t total_size;
+  uint32_t table_capacity;
+  uint32_t pad0;
+  uint64_t heap_offset;      // byte offset of heap start
+  uint64_t free_head;        // offset of first free block, 0 = none
+  uint64_t bytes_allocated;  // live payload bytes
+  uint64_t num_objects;
+  uint64_t evictions;
+  uint32_t lru_head;  // entry index + 1, 0 = none (most recent at head)
+  uint32_t lru_tail;
+  std::atomic<uint32_t> lock;
+  uint32_t pad1;
+};
+
+// state values
+enum : uint8_t { EMPTY = 0, CREATED = 1, SEALED = 2, TOMB = 3 };
+
+struct Entry {
+  uint8_t id[16];
+  uint64_t offset;
+  uint64_t size;
+  int32_t refcount;
+  uint8_t state;
+  uint8_t pad[3];
+  uint32_t lru_prev;  // index + 1
+  uint32_t lru_next;
+};
+
+struct FreeBlock {  // lives at the start of each free heap block
+  uint64_t size;    // includes this header
+  uint64_t next;    // offset of next free block, 0 = none
+};
+
+// Every allocated block is preceded by an 8-byte size field.
+static const uint64_t kBlockHdr = 8;
+
+static inline Header* H(void* base) { return reinterpret_cast<Header*>(base); }
+static inline Entry* table(void* base) {
+  return reinterpret_cast<Entry*>(reinterpret_cast<char*>(base) + sizeof(Header));
+}
+static inline FreeBlock* FB(void* base, uint64_t off) {
+  return reinterpret_cast<FreeBlock*>(reinterpret_cast<char*>(base) + off);
+}
+
+static void lock(Header* h) {
+  uint32_t expected = 0;
+  int spins = 0;
+  while (!h->lock.compare_exchange_weak(expected, 1, std::memory_order_acquire)) {
+    expected = 0;
+    if (++spins > 4096) {
+#if defined(__x86_64__)
+      __builtin_ia32_pause();
+#endif
+      spins = 0;
+    }
+  }
+}
+static void unlock(Header* h) { h->lock.store(0, std::memory_order_release); }
+
+static inline uint64_t align_up(uint64_t v) { return (v + kAlign - 1) & ~(kAlign - 1); }
+
+int rts_init(void* base, uint64_t total_size, uint32_t table_capacity) {
+  Header* h = H(base);
+  std::memset(base, 0, sizeof(Header));
+  h->magic = kMagic;
+  h->total_size = total_size;
+  h->table_capacity = table_capacity;
+  uint64_t table_bytes = (uint64_t)table_capacity * sizeof(Entry);
+  std::memset(table(base), 0, table_bytes);
+  h->heap_offset = align_up(sizeof(Header) + table_bytes);
+  if (h->heap_offset + sizeof(FreeBlock) >= total_size) return -1;
+  h->free_head = h->heap_offset;
+  FreeBlock* fb = FB(base, h->heap_offset);
+  fb->size = total_size - h->heap_offset;
+  fb->next = 0;
+  h->lru_head = h->lru_tail = 0;
+  h->lock.store(0);
+  return 0;
+}
+
+int rts_attached_ok(void* base) { return H(base)->magic == kMagic ? 0 : -1; }
+
+// ---- hash table ------------------------------------------------------------
+
+static uint64_t id_hash(const uint8_t id[16]) {
+  uint64_t a, b;
+  std::memcpy(&a, id, 8);
+  std::memcpy(&b, id + 8, 8);
+  uint64_t x = a ^ (b * 0x9e3779b97f4a7c15ULL);
+  x ^= x >> 33;
+  x *= 0xff51afd7ed558ccdULL;
+  x ^= x >> 33;
+  return x;
+}
+
+// find entry index or -1; if insert, returns a free/tomb slot when absent.
+static int64_t find_slot(void* base, const uint8_t id[16], bool insert) {
+  Header* h = H(base);
+  Entry* t = table(base);
+  uint32_t cap = h->table_capacity;
+  uint64_t i = id_hash(id) % cap;
+  int64_t first_tomb = -1;
+  for (uint32_t probe = 0; probe < cap; ++probe, i = (i + 1) % cap) {
+    Entry& e = t[i];
+    if (e.state == EMPTY) {
+      if (!insert) return -1;
+      return first_tomb >= 0 ? first_tomb : (int64_t)i;
+    }
+    if (e.state == TOMB) {
+      if (first_tomb < 0) first_tomb = (int64_t)i;
+      continue;
+    }
+    if (std::memcmp(e.id, id, 16) == 0) return (int64_t)i;
+  }
+  return insert ? first_tomb : -1;
+}
+
+// ---- LRU list (sealed, refcount==0 objects only) ---------------------------
+
+static void lru_unlink(Header* h, Entry* t, uint32_t idx) {
+  Entry& e = t[idx];
+  if (e.lru_prev) t[e.lru_prev - 1].lru_next = e.lru_next;
+  else if (h->lru_head == idx + 1) h->lru_head = e.lru_next;
+  if (e.lru_next) t[e.lru_next - 1].lru_prev = e.lru_prev;
+  else if (h->lru_tail == idx + 1) h->lru_tail = e.lru_prev;
+  e.lru_prev = e.lru_next = 0;
+}
+
+static void lru_push_head(Header* h, Entry* t, uint32_t idx) {
+  Entry& e = t[idx];
+  e.lru_prev = 0;
+  e.lru_next = h->lru_head;
+  if (h->lru_head) t[h->lru_head - 1].lru_prev = idx + 1;
+  h->lru_head = idx + 1;
+  if (!h->lru_tail) h->lru_tail = idx + 1;
+}
+
+// ---- allocator -------------------------------------------------------------
+
+static uint64_t heap_alloc(void* base, uint64_t payload) {
+  Header* h = H(base);
+  uint64_t need = align_up(payload + kBlockHdr);
+  uint64_t prev = 0, cur = h->free_head;
+  while (cur) {
+    FreeBlock* fb = FB(base, cur);
+    if (fb->size >= need) {
+      uint64_t rem = fb->size - need;
+      if (rem >= sizeof(FreeBlock) + kAlign) {
+        // split: keep remainder as free block
+        uint64_t rem_off = cur + need;
+        FreeBlock* rb = FB(base, rem_off);
+        rb->size = rem;
+        rb->next = fb->next;
+        if (prev) FB(base, prev)->next = rem_off;
+        else h->free_head = rem_off;
+      } else {
+        need = fb->size;  // absorb the sliver
+        if (prev) FB(base, prev)->next = fb->next;
+        else h->free_head = fb->next;
+      }
+      *reinterpret_cast<uint64_t*>(reinterpret_cast<char*>(base) + cur) = need;
+      return cur + kBlockHdr;
+    }
+    prev = cur;
+    cur = fb->next;
+  }
+  return 0;
+}
+
+static void heap_free(void* base, uint64_t payload_off) {
+  Header* h = H(base);
+  uint64_t blk = payload_off - kBlockHdr;
+  uint64_t size = *reinterpret_cast<uint64_t*>(reinterpret_cast<char*>(base) + blk);
+  // insert into offset-sorted free list, coalescing neighbors
+  uint64_t prev = 0, cur = h->free_head;
+  while (cur && cur < blk) {
+    prev = cur;
+    cur = FB(base, cur)->next;
+  }
+  FreeBlock* nb = FB(base, blk);
+  nb->size = size;
+  nb->next = cur;
+  if (prev) FB(base, prev)->next = blk;
+  else h->free_head = blk;
+  // coalesce with next
+  if (cur && blk + size == cur) {
+    nb->size += FB(base, cur)->size;
+    nb->next = FB(base, cur)->next;
+  }
+  // coalesce with prev
+  if (prev && prev + FB(base, prev)->size == blk) {
+    FB(base, prev)->size += nb->size;
+    FB(base, prev)->next = nb->next;
+  }
+}
+
+// evict LRU sealed refcount==0 objects until `need` payload bytes fit.
+// Returns 0 if an allocation of `need` should now succeed.
+static int evict_for(void* base, uint64_t need) {
+  Header* h = H(base);
+  Entry* t = table(base);
+  while (h->lru_tail) {
+    // try alloc first
+    uint64_t off = heap_alloc(base, need);
+    if (off) {
+      heap_free(base, off);  // probe only
+      return 0;
+    }
+    uint32_t idx = h->lru_tail - 1;
+    Entry& e = t[idx];
+    lru_unlink(h, t, idx);
+    heap_free(base, e.offset);
+    h->bytes_allocated -= e.size;
+    h->num_objects--;
+    h->evictions++;
+    e.state = TOMB;
+  }
+  return 0;
+}
+
+// ---- public object API -----------------------------------------------------
+
+// returns 0 ok; -1 exists; -2 out of memory; -3 table full
+int rts_create(void* base, const uint8_t id[16], uint64_t size, uint64_t* offset_out) {
+  Header* h = H(base);
+  lock(h);
+  int64_t slot = find_slot(base, id, true);
+  if (slot < 0) {
+    unlock(h);
+    return -3;
+  }
+  Entry* t = table(base);
+  if (t[slot].state == CREATED || t[slot].state == SEALED) {
+    unlock(h);
+    return -1;
+  }
+  uint64_t off = heap_alloc(base, size);
+  if (!off) {
+    evict_for(base, size);
+    off = heap_alloc(base, size);
+    if (!off) {
+      unlock(h);
+      return -2;
+    }
+  }
+  Entry& e = t[slot];
+  std::memcpy(e.id, id, 16);
+  e.offset = off;
+  e.size = size;
+  e.refcount = 1;  // creator holds a ref until seal+release
+  e.state = CREATED;
+  e.lru_prev = e.lru_next = 0;
+  h->bytes_allocated += size;
+  h->num_objects++;
+  *offset_out = off;
+  unlock(h);
+  return 0;
+}
+
+int rts_seal(void* base, const uint8_t id[16]) {
+  Header* h = H(base);
+  lock(h);
+  int64_t slot = find_slot(base, id, false);
+  if (slot < 0 || table(base)[slot].state != CREATED) {
+    unlock(h);
+    return -1;
+  }
+  table(base)[slot].state = SEALED;
+  unlock(h);
+  return 0;
+}
+
+// returns 0 ok (ref++); -1 absent or unsealed
+int rts_get(void* base, const uint8_t id[16], uint64_t* offset_out, uint64_t* size_out) {
+  Header* h = H(base);
+  lock(h);
+  int64_t slot = find_slot(base, id, false);
+  if (slot < 0) {
+    unlock(h);
+    return -1;
+  }
+  Entry& e = table(base)[slot];
+  if (e.state != SEALED) {
+    unlock(h);
+    return -1;
+  }
+  if (e.refcount == 0) lru_unlink(h, table(base), (uint32_t)slot);
+  e.refcount++;
+  *offset_out = e.offset;
+  *size_out = e.size;
+  unlock(h);
+  return 0;
+}
+
+int rts_contains(void* base, const uint8_t id[16]) {
+  Header* h = H(base);
+  lock(h);
+  int64_t slot = find_slot(base, id, false);
+  int r = (slot >= 0 && table(base)[slot].state == SEALED) ? 1 : 0;
+  unlock(h);
+  return r;
+}
+
+int rts_release(void* base, const uint8_t id[16]) {
+  Header* h = H(base);
+  lock(h);
+  int64_t slot = find_slot(base, id, false);
+  if (slot < 0) {
+    unlock(h);
+    return -1;
+  }
+  Entry& e = table(base)[slot];
+  if (e.refcount > 0) {
+    e.refcount--;
+    if (e.refcount == 0 && e.state == SEALED)
+      lru_push_head(h, table(base), (uint32_t)slot);
+  }
+  unlock(h);
+  return 0;
+}
+
+int rts_delete(void* base, const uint8_t id[16]) {
+  Header* h = H(base);
+  lock(h);
+  int64_t slot = find_slot(base, id, false);
+  if (slot < 0) {
+    unlock(h);
+    return -1;
+  }
+  Entry& e = table(base)[slot];
+  if (e.refcount > 0 && e.state == SEALED) {
+    unlock(h);
+    return -2;  // still referenced
+  }
+  if (e.refcount == 0 && e.state == SEALED) lru_unlink(h, table(base), (uint32_t)slot);
+  heap_free(base, e.offset);
+  h->bytes_allocated -= e.size;
+  h->num_objects--;
+  e.state = TOMB;
+  unlock(h);
+  return 0;
+}
+
+void rts_stats(void* base, uint64_t* bytes_allocated, uint64_t* num_objects,
+               uint64_t* evictions, uint64_t* capacity) {
+  Header* h = H(base);
+  lock(h);
+  *bytes_allocated = h->bytes_allocated;
+  *num_objects = h->num_objects;
+  *evictions = h->evictions;
+  *capacity = h->total_size - h->heap_offset;
+  unlock(h);
+}
+
+}  // extern "C"

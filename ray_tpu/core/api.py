@@ -24,13 +24,29 @@ _runtime_lock = threading.RLock()
 
 class ObjectRef:
     """A future for a task result or `put` value. Owned by the worker that
-    created it (reference: ownership model, core_worker/reference_count.h)."""
+    created it (reference: ownership model, core_worker/reference_count.h).
 
-    __slots__ = ("id", "owner")
+    Each live ObjectRef instance holds one local reference on the
+    object's store slot; when the last instance is garbage-collected the
+    runtime may free the value (reference: ReferenceCounter local refs,
+    core_worker/reference_count.h:66)."""
+
+    __slots__ = ("id", "owner", "__weakref__")
 
     def __init__(self, id: ObjectID, owner: str | None = None):
         self.id = id
         self.owner = owner
+        rt = _runtime
+        if rt is not None:
+            rt._incref(id)
+
+    def __del__(self):
+        rt = _runtime
+        if rt is not None:
+            try:
+                rt._decref(self.id)
+            except Exception:
+                pass
 
     def hex(self) -> str:
         return self.id.hex()

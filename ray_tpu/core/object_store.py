@@ -1,0 +1,248 @@
+"""Node-local shared-memory object store (Python side).
+
+Reference parity: the plasma store + client
+(src/ray/object_manager/plasma/store.h:55, client.h) and the two-tier
+store providers (src/ray/core_worker/store_provider/). Design departure:
+no store server process — every worker maps the same named shm segment
+and calls into the native allocator library (ray_tpu/_native/object_store.cc)
+directly under a process-shared lock, so create/get are library calls,
+not RPCs.
+
+Two implementations with one interface:
+- `SharedMemoryStore`: one big segment + native C++ allocator (preferred).
+- `SegmentPerObjectStore`: pure-Python fallback, one shm segment per
+  object (slower create, still zero-copy cross-process).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import secrets
+import threading
+from multiprocessing import shared_memory
+
+DEFAULT_CAPACITY = int(os.environ.get("RAY_TPU_OBJECT_STORE_BYTES", 512 * 1024 * 1024))
+_TABLE_CAPACITY = 65536
+
+
+class ObjectStoreFullError(MemoryError):
+    pass
+
+
+def _load_native():
+    from ray_tpu import _native
+
+    path = _native.build_library("object_store")
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.rts_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32]
+    lib.rts_attached_ok.argtypes = [ctypes.c_void_p]
+    lib.rts_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, u64p]
+    lib.rts_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rts_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u64p, u64p]
+    lib.rts_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rts_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rts_stats.argtypes = [ctypes.c_void_p, u64p, u64p, u64p, u64p]
+    for f in ("rts_init", "rts_attached_ok", "rts_create", "rts_seal", "rts_get",
+              "rts_contains", "rts_release", "rts_delete"):
+        getattr(lib, f).restype = ctypes.c_int
+    return lib
+
+
+_native_lib = None
+_native_lock = threading.Lock()
+
+
+def native_lib():
+    global _native_lib
+    if _native_lib is None:
+        with _native_lock:
+            if _native_lib is None:
+                _native_lib = _load_native() or False
+    return _native_lib or None
+
+
+class SharedMemoryStore:
+    """One shm segment, native allocator. All sizes in bytes."""
+
+    def __init__(self, name: str | None = None, capacity: int = DEFAULT_CAPACITY,
+                 create: bool = True):
+        self._lib = native_lib()
+        if self._lib is None:
+            raise RuntimeError("native object store library unavailable")
+        if create:
+            name = name or f"rts_{secrets.token_hex(6)}"
+            self._shm = shared_memory.SharedMemory(name=name, create=True, size=capacity)
+            self._base = ctypes.addressof(ctypes.c_char.from_buffer(self._shm.buf))
+            if self._lib.rts_init(self._base, self._shm.size, _TABLE_CAPACITY) != 0:
+                raise RuntimeError("object store segment too small")
+        else:
+            self._shm = shared_memory.SharedMemory(name=name, create=False)
+            self._base = ctypes.addressof(ctypes.c_char.from_buffer(self._shm.buf))
+            if self._lib.rts_attached_ok(self._base) != 0:
+                raise RuntimeError(f"shm segment {name} is not an object store")
+        self.name = self._shm.name
+        self._owner = create
+
+    # -- raw buffer protocol --------------------------------------------------
+
+    def create(self, oid: bytes, size: int) -> memoryview:
+        off = ctypes.c_uint64()
+        rc = self._lib.rts_create(self._base, oid, size, ctypes.byref(off))
+        if rc == -1:
+            raise KeyError(f"object {oid.hex()} already exists")
+        if rc == -2:
+            raise ObjectStoreFullError(
+                f"object of {size} bytes does not fit in store {self.name}")
+        if rc != 0:
+            raise RuntimeError(f"object table full (rc={rc})")
+        o = off.value
+        return self._shm.buf[o:o + size]
+
+    def seal(self, oid: bytes):
+        if self._lib.rts_seal(self._base, oid) != 0:
+            raise KeyError(f"seal: no unsealed object {oid.hex()}")
+
+    def put(self, oid: bytes, data) -> None:
+        data = memoryview(data).cast("B")
+        buf = self.create(oid, data.nbytes)
+        buf[:] = data
+        self.seal(oid)
+        self._lib.rts_release(self._base, oid)
+
+    def get(self, oid: bytes) -> memoryview | None:
+        """Returns a zero-copy view (holds a refcount; call release(oid))."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        if self._lib.rts_get(self._base, oid, ctypes.byref(off), ctypes.byref(size)) != 0:
+            return None
+        return self._shm.buf[off.value:off.value + size.value]
+
+    def contains(self, oid: bytes) -> bool:
+        return bool(self._lib.rts_contains(self._base, oid))
+
+    def release(self, oid: bytes):
+        self._lib.rts_release(self._base, oid)
+
+    def delete(self, oid: bytes):
+        self._lib.rts_delete(self._base, oid)
+
+    def stats(self) -> dict:
+        a = ctypes.c_uint64(); n = ctypes.c_uint64()
+        e = ctypes.c_uint64(); c = ctypes.c_uint64()
+        self._lib.rts_stats(self._base, ctypes.byref(a), ctypes.byref(n),
+                            ctypes.byref(e), ctypes.byref(c))
+        return {"bytes_allocated": a.value, "num_objects": n.value,
+                "evictions": e.value, "capacity": c.value}
+
+    def close(self):
+        # drop ctypes' from_buffer export before closing the mmap
+        self._base = None
+        import gc
+        gc.collect()
+        try:
+            self._shm.close()
+        except BufferError:
+            pass
+
+    def unlink(self):
+        if self._owner:
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass
+
+
+class SegmentPerObjectStore:
+    """Fallback: one shm segment per object, discovered by name. No
+    eviction, no allocator — used only when g++ is unavailable."""
+
+    def __init__(self, name: str | None = None, capacity: int = 0, create: bool = True):
+        self.name = name or f"rts_{secrets.token_hex(6)}"
+        self._held: dict[bytes, shared_memory.SharedMemory] = {}
+        self._unsealed: dict[bytes, shared_memory.SharedMemory] = {}
+        self._owner = create
+
+    def _seg_name(self, oid: bytes) -> str:
+        return f"{self.name}_{oid.hex()[:24]}"
+
+    # segment layout: [u8 sealed][7 pad][u64 size][payload]
+    _HDR = 16
+
+    def create(self, oid: bytes, size: int) -> memoryview:
+        seg = shared_memory.SharedMemory(self._seg_name(oid), create=True,
+                                         size=max(1, size) + self._HDR)
+        seg.buf[0] = 0  # unsealed
+        seg.buf[8:16] = size.to_bytes(8, "little")
+        self._unsealed[oid] = seg
+        return seg.buf[self._HDR:self._HDR + size]
+
+    def seal(self, oid: bytes):
+        seg = self._unsealed.pop(oid, None)
+        if seg is None:
+            raise KeyError(f"seal: no unsealed object {oid.hex()}")
+        seg.buf[0] = 1
+        self._held[oid] = seg
+
+    def put(self, oid: bytes, data) -> None:
+        data = memoryview(data).cast("B")
+        buf = self.create(oid, data.nbytes)
+        buf[:] = data
+        self.seal(oid)
+
+    def get(self, oid: bytes) -> memoryview | None:
+        if oid in self._unsealed:
+            return None
+        seg = self._held.get(oid)
+        if seg is None:
+            try:
+                seg = shared_memory.SharedMemory(self._seg_name(oid), create=False)
+            except FileNotFoundError:
+                return None
+            self._held[oid] = seg
+        if seg.buf[0] != 1:  # not sealed yet
+            return None
+        size = int.from_bytes(bytes(seg.buf[8:16]), "little")
+        return seg.buf[self._HDR:self._HDR + size]
+
+    def contains(self, oid: bytes) -> bool:
+        return self.get(oid) is not None
+
+    def release(self, oid: bytes):
+        pass
+
+    def delete(self, oid: bytes):
+        seg = self._held.pop(oid, None)
+        if seg is not None:
+            try:
+                seg.close()
+                seg.unlink()
+            except FileNotFoundError:
+                pass
+
+    def stats(self) -> dict:
+        return {"bytes_allocated": 0, "num_objects": len(self._held),
+                "evictions": 0, "capacity": 0}
+
+    def close(self):
+        for seg in list(self._held.values()) + list(self._unsealed.values()):
+            try:
+                seg.close()
+            except Exception:
+                pass
+
+    def unlink(self):
+        if self._owner:
+            for oid in list(self._held):
+                self.delete(oid)
+
+
+def open_store(name: str | None = None, capacity: int = DEFAULT_CAPACITY,
+               create: bool = True):
+    if native_lib() is not None:
+        return SharedMemoryStore(name=name, capacity=capacity, create=create)
+    return SegmentPerObjectStore(name=name, capacity=capacity, create=create)

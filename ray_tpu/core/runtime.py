@@ -66,6 +66,8 @@ class _LocalActor:
     death_cause: str = ""
     restarts_left: int = 0
     threads: list = dataclasses.field(default_factory=list)
+    init_lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
+    init_done: threading.Event = dataclasses.field(default_factory=threading.Event)
 
 
 class _Context(threading.local):
@@ -85,6 +87,7 @@ class LocalRuntime:
         self.worker_id = WorkerID.random()
         self.namespace = namespace or "default"
         self._objects: dict[ObjectID, _Slot] = {}
+        self._refcounts: dict[ObjectID, int] = {}
         self._objects_lock = threading.Lock()
         self._actors: dict[ActorID, _LocalActor] = {}
         self._named: dict[tuple[str, str], ActorID] = {}
@@ -105,6 +108,22 @@ class LocalRuntime:
             if s is None:
                 s = self._objects[oid] = _Slot()
             return s
+
+    # Local reference counting driven by ObjectRef lifetime (reference:
+    # ReferenceCounter, core_worker/reference_count.h:66). When the last
+    # ObjectRef to an oid is GC'd, the stored value is dropped.
+    def _incref(self, oid: ObjectID):
+        with self._objects_lock:
+            self._refcounts[oid] = self._refcounts.get(oid, 0) + 1
+
+    def _decref(self, oid: ObjectID):
+        with self._objects_lock:
+            c = self._refcounts.get(oid, 0) - 1
+            if c <= 0:
+                self._refcounts.pop(oid, None)
+                self._objects.pop(oid, None)
+            else:
+                self._refcounts[oid] = c
 
     def put(self, value) -> ObjectRef:
         if isinstance(value, ObjectRef):
@@ -182,13 +201,15 @@ class LocalRuntime:
             tries = opts.max_retries + 1 if opts.retry_exceptions else 1
             with self._events.span(name, "task"):
                 for attempt in range(max(1, tries)):
-                    if slots[0].cancelled:
+                    if any(s.cancelled for s in slots):
                         for s in slots:
                             s.set_error(exc.TaskCancelledError(name))
                         return
                     try:
                         a, kw = self._resolve_args(args, kwargs)
                         result = fn(*a, **kw)
+                        if n == 0:
+                            return
                         if n == 1:
                             slots[0].set_value(result)
                         else:
@@ -211,6 +232,8 @@ class LocalRuntime:
 
         threading.Thread(target=run, daemon=True, name=f"task-{name}").start()
         refs = [ObjectRef(o) for o in oids]
+        if n == 0:
+            return []
         return refs[0] if n == 1 else refs
 
     def cancel(self, ref: ObjectRef, force=False, recursive=True):
@@ -257,13 +280,17 @@ class LocalRuntime:
 
     def _actor_loop(self, actor: _LocalActor):
         self._ctx.actor_id = actor.actor_id
-        if actor.instance is None and not actor.dead:
-            try:
-                a, kw = self._resolve_args(actor.args, actor.kwargs)
-                actor.instance = actor.cls(*a, **kw)
-            except Exception as e:  # noqa: BLE001
-                actor.dead = True
-                actor.death_cause = f"__init__ failed: {e}\n{traceback.format_exc()}"
+        with actor.init_lock:
+            if actor.instance is None and not actor.dead and not actor.init_done.is_set():
+                try:
+                    a, kw = self._resolve_args(actor.args, actor.kwargs)
+                    actor.instance = actor.cls(*a, **kw)
+                except Exception as e:  # noqa: BLE001
+                    actor.dead = True
+                    actor.death_cause = f"__init__ failed: {e}\n{traceback.format_exc()}"
+                finally:
+                    actor.init_done.set()
+        actor.init_done.wait()
         while not actor.dead and not self._shutdown:
             try:
                 item = actor.inbox.get(timeout=0.1)
@@ -286,6 +313,21 @@ class LocalRuntime:
                     err = exc.TaskError.from_exception(e, f"{actor.cls.__name__}.{mname}")
                     for s in slots:
                         s.set_error(err)
+        # Error-drain anything still queued so callers never hang on a
+        # dead actor (one loop thread may exit while others drain too —
+        # set_error is idempotent enough: first writer wins the event).
+        self._drain_actor_inbox(actor)
+
+    def _drain_actor_inbox(self, actor: _LocalActor):
+        cause = actor.death_cause or "actor exited"
+        try:
+            while True:
+                item = actor.inbox.get_nowait()
+                if item:
+                    for s in item[3]:
+                        s.set_error(exc.ActorDiedError(cause))
+        except _queue.Empty:
+            pass
 
     def submit_actor_task(self, actor_id: ActorID, mname: str, args, kwargs, mopts: dict):
         with self._actors_lock:
@@ -300,6 +342,10 @@ class LocalRuntime:
                 s.set_error(exc.ActorDiedError(actor.death_cause or "actor is dead"))
         else:
             actor.inbox.put((mname, args, kwargs, slots))
+            if actor.dead:
+                # lost the race with actor death: loop threads may have
+                # already drained and exited — drain again ourselves.
+                self._drain_actor_inbox(actor)
         refs = [ObjectRef(o) for o in oids]
         return refs[0] if n == 1 else refs
 

@@ -1,0 +1,96 @@
+"""Object serialization: cloudpickle envelope + out-of-band buffers.
+
+Reference parity: python/ray/_private/serialization.py:122
+(SerializationContext — msgpack + pickle5 with out-of-band buffers,
+zero-copy numpy). Same idea here: pickle protocol 5 with a
+buffer_callback so large array payloads (numpy, and jax arrays via
+numpy view) are written separately from the pickle stream and can be
+mapped zero-copy out of the shared-memory store on the read side.
+
+Wire format (one contiguous blob):
+  [u32 magic][u32 nbuf][u64 pickle_len][u64 buf_len]*nbuf
+  [pickle bytes][pad to 64][buf0][pad to 64][buf1]...
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from typing import Any
+
+import cloudpickle
+
+_MAGIC = 0x52545053  # "RTPS"
+_ALIGN = 64
+
+
+def _pad(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def serialize(obj: Any) -> tuple[bytes, list[memoryview], int]:
+    """Returns (header+pickle bytes, out-of-band buffers, total_size)."""
+    buffers: list[pickle.PickleBuffer] = []
+    payload = cloudpickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
+    views = [b.raw() for b in buffers]
+    head = struct.pack("<II", _MAGIC, len(views))
+    head += struct.pack("<Q", len(payload))
+    for v in views:
+        head += struct.pack("<Q", v.nbytes)
+    total = _pad(len(head) + len(payload))
+    for v in views:
+        total = _pad(total + v.nbytes)
+    return head + payload, views, total
+
+
+def write_into(buf: memoryview, head_payload: bytes, views: list[memoryview]):
+    off = len(head_payload)
+    buf[:off] = head_payload
+    off = _pad(off)
+    for v in views:
+        flat = v.cast("B") if v.ndim == 1 else memoryview(bytes(v))
+        buf[off:off + flat.nbytes] = flat
+        off = _pad(off + flat.nbytes)
+
+
+def dumps(obj: Any) -> bytes:
+    head_payload, views, total = serialize(obj)
+    out = bytearray(total)
+    write_into(memoryview(out), head_payload, views)
+    return bytes(out)
+
+
+def deserialize(buf: memoryview) -> Any:
+    buf = buf.cast("B") if isinstance(buf, memoryview) else memoryview(buf)
+    magic, nbuf = struct.unpack_from("<II", buf, 0)
+    if magic != _MAGIC:
+        raise ValueError("not a ray_tpu serialized object")
+    off = 8
+    (plen,) = struct.unpack_from("<Q", buf, off)
+    off += 8
+    blens = []
+    for _ in range(nbuf):
+        (bl,) = struct.unpack_from("<Q", buf, off)
+        off += 8
+        blens.append(bl)
+    pickle_bytes = bytes(buf[off:off + plen])
+    off = _pad(off + plen)
+    oob = []
+    for bl in blens:
+        oob.append(buf[off:off + bl])
+        off = _pad(off + bl)
+    return pickle.loads(pickle_bytes, buffers=oob)
+
+
+def loads(data: bytes | memoryview) -> Any:
+    return deserialize(memoryview(data))
+
+
+def dumps_msg(obj: Any) -> bytes:
+    """Serialize a small control-plane message (no out-of-band path)."""
+    return cloudpickle.dumps(obj, protocol=5)
+
+
+def loads_msg(data: bytes) -> Any:
+    return pickle.loads(data)

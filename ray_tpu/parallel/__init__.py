@@ -19,9 +19,7 @@ from ray_tpu.parallel.mesh import (
 )
 from ray_tpu.parallel.sharding import (
     PartitionRules,
-    named_sharding_tree,
     shard_pytree,
-    spec_for_path,
 )
 
 __all__ = [
@@ -35,7 +33,5 @@ __all__ = [
     "build_mesh",
     "local_mesh",
     "PartitionRules",
-    "named_sharding_tree",
     "shard_pytree",
-    "spec_for_path",
 ]

@@ -83,14 +83,6 @@ def _prune_spec(spec: PartitionSpec, mesh) -> PartitionSpec:
     return PartitionSpec(*(prune(e) for e in spec))
 
 
-def spec_for_path(rules: PartitionRules, path: str, mesh: Mesh | None = None):
-    return rules.spec_for(path, mesh)
-
-
-def named_sharding_tree(rules: PartitionRules, tree: PyTree, mesh: Mesh) -> PyTree:
-    return rules.shardings(tree, mesh)
-
-
 def shard_pytree(tree: PyTree, rules: PartitionRules, mesh: Mesh) -> PyTree:
     """Device-put `tree` with shardings derived from `rules`."""
     shardings = rules.shardings(tree, mesh)
