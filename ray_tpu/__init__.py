@@ -25,11 +25,14 @@ python/ray/remote_function.py:41, python/ray/actor.py:602):
 from ray_tpu._version import __version__
 from ray_tpu.core.api import (
     ObjectRef,
+    available_resources,
     cancel,
+    cluster_resources,
     get,
     get_actor,
     get_runtime_context,
     init,
+    timeline,
     is_initialized,
     kill,
     method,
@@ -43,11 +46,14 @@ from ray_tpu.core.api import (
 __all__ = [
     "__version__",
     "ObjectRef",
+    "available_resources",
     "cancel",
+    "cluster_resources",
     "get",
     "get_actor",
     "get_runtime_context",
     "init",
+    "timeline",
     "is_initialized",
     "kill",
     "method",

@@ -1,0 +1,820 @@
+"""ClusterRuntime — the in-process runtime for drivers AND workers.
+
+Reference parity: CoreWorker (src/ray/core_worker/core_worker.h:166).
+Like the reference, every process (driver or worker) runs the same
+runtime: it owns the objects it creates (ownership model from the
+"Ownership" paper, reference README.rst:75-76), submits tasks to its
+local nodelet, receives results DIRECTLY from executing workers
+(worker→owner RPC, bypassing head and nodelet — the decentralized hot
+path), and serves object resolution to borrowers.
+
+Object plane:
+- results ≤ INLINE_THRESHOLD ride inline in the worker→owner task_done
+  message (reference: small returns go to the owner's in-process memory
+  store, core_worker.cc ExecuteTask);
+- larger results live in the executing node's shm store; the owner
+  records the location; `get` pulls them into the local store via the
+  nodelet (PullManager equivalent) and reads zero-copy.
+"""
+
+from __future__ import annotations
+
+import atexit
+import hashlib
+import os
+import threading
+import time
+from typing import Any, Callable
+
+import cloudpickle
+
+from ray_tpu.core import exceptions as exc
+from ray_tpu.core import serialization as ser
+from ray_tpu.core.api import ActorHandle, ObjectRef
+from ray_tpu.core.head import dataclass_dict
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_store import open_store
+from ray_tpu.core.options import ActorOptions, TaskOptions
+from ray_tpu.core.rpc import PeerUnavailableError, RpcClient, RpcServer
+from ray_tpu.core.specs import INLINE_THRESHOLD, ActorSpec, RefArg, TaskSpec
+from ray_tpu.utils.events import TaskEventLog
+
+
+class _Owned:
+    """State of an object this process owns."""
+
+    __slots__ = ("event", "inline", "value_cached", "has_cached", "location",
+                 "store_name", "error", "spec", "retries_left", "served_borrow",
+                 "cancelled")
+
+    def __init__(self, spec: TaskSpec | None = None, retries_left: int = 0):
+        self.event = threading.Event()
+        self.inline: bytes | None = None
+        self.value_cached = None
+        self.has_cached = False
+        self.location: str | None = None  # nodelet address holding the bytes
+        self.store_name: str | None = None
+        self.error: BaseException | None = None
+        self.spec = spec
+        self.retries_left = retries_left
+        self.served_borrow = False
+        self.cancelled = False
+
+
+class _Context(threading.local):
+    def __init__(self):
+        self.actor_id = None
+        self.task_id = None
+
+
+class ClusterRuntime:
+    def __init__(self, address: str | None = None, num_cpus=None, num_tpus=None,
+                 resources=None, namespace=None, labels=None, mode="driver",
+                 head=None, nodelet=None, store_capacity=None, **_):
+        self.mode = mode
+        self.namespace = namespace or "default"
+        self.job_id = JobID.random()
+        self.worker_id = WorkerID.random()
+        self._ctx = _Context()
+        self._events = TaskEventLog()
+        self.client = RpcClient.shared()
+        self._lock = threading.RLock()
+        self._owned: dict[bytes, _Owned] = {}
+        self._refcounts: dict[bytes, int] = {}
+        self._fn_cache: dict[str, Callable] = {}
+        self._exported_fns: set[str] = set()
+        self._actor_addr: dict[bytes, str] = {}
+        self._actor_meta: dict[bytes, dict] = {}
+        # Store buffers pinned because a deserialized object graph aliases
+        # them zero-copy (plasma pin semantics); released when the owning
+        # object is freed or at shutdown.
+        self._pins: dict[bytes, memoryview] = {}
+        # Refs riding as args of in-flight tasks hold a reference until
+        # the task reaches a terminal state (reference: TaskManager
+        # "submitted task references", core_worker/task_manager.h:212).
+        self._task_arg_refs: dict[bytes, list[bytes]] = {}
+        self._booted = []  # in-process services we own (head/nodelet)
+        self._shutdown_flag = False
+
+        self.server = RpcServer(name=f"rt-{mode}", num_threads=32)
+        self.server.register("task_done", self._h_task_done, oneway=True)
+        self.server.register("resolve", self._h_resolve)
+        self.server.register("pubsub", self._h_pubsub, oneway=True)
+        self.server.register("ping", lambda m, f: "pong")
+        self.address = self.server.address
+
+        if mode == "driver":
+            self._boot_or_connect(address, num_cpus, num_tpus, resources or {},
+                                  labels or {}, store_capacity)
+            atexit.register(self.shutdown)
+        # worker mode: worker_main wires head/nodelet/store explicitly
+        elif head is not None:
+            self.head_address = head
+            self.nodelet_address = nodelet
+            self.node_id = None
+            self.store = None
+        self.server.start()
+        # actor lifecycle events keep the address cache + arg pins fresh
+        try:
+            self.client.call(self.head_address, "subscribe",
+                             {"topics": ["actor"], "address": self.address},
+                             timeout=10)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------ boot
+
+    def _boot_or_connect(self, address, num_cpus, num_tpus, resources, labels,
+                         store_capacity):
+        from ray_tpu.core.head import Head
+        from ray_tpu.core.nodelet import Nodelet
+
+        if address is None:
+            session = f"session_{int(time.time())}_{os.getpid()}"
+            session_dir = os.path.join("/tmp/ray_tpu", session)
+            os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+            head = Head(session_name=session).start()
+            self._booted.append(head)
+            res = dict(resources)
+            res.setdefault("CPU", float(num_cpus if num_cpus is not None
+                                        else os.cpu_count() or 4))
+            if num_tpus is not None:
+                res["TPU"] = float(num_tpus)
+            else:
+                ntpu = _detect_tpu_chips()
+                if ntpu:
+                    res["TPU"] = float(ntpu)
+            nodelet = Nodelet(head.address, res, labels=labels,
+                              session_dir=session_dir,
+                              store_capacity=store_capacity).start()
+            self._booted.append(nodelet)
+            self.head_address = head.address
+            self.session_dir = session_dir
+        else:
+            self.head_address = address
+            self.session_dir = "/tmp/ray_tpu"
+            self.client.call(self.head_address, "ping", {}, timeout=10, retries=3)
+        # attach to a local nodelet (lowest node = first registered)
+        view = self.client.call(self.head_address, "cluster_view", {}, timeout=10)
+        if not view["nodes"]:
+            raise RuntimeError("no nodes in cluster")
+        node = view["nodes"][0]
+        self.nodelet_address = node["address"]
+        self.node_id = NodeID(node["node_id"])
+        self.store = open_store(name=node["store_name"], create=False)
+
+    # ------------------------------------------------------------ refcounting
+
+    def _incref(self, oid):
+        b = oid.binary() if hasattr(oid, "binary") else oid
+        with self._lock:
+            self._refcounts[b] = self._refcounts.get(b, 0) + 1
+
+    def _decref(self, oid):
+        b = oid.binary() if hasattr(oid, "binary") else oid
+        with self._lock:
+            c = self._refcounts.get(b, 0) - 1
+            if c > 0:
+                self._refcounts[b] = c
+                return
+            self._refcounts.pop(b, None)
+            st = self._owned.get(b)
+            if st is None or not st.event.is_set() or st.served_borrow:
+                return  # pending results / borrowed objects stay
+            self._owned.pop(b, None)
+        self._release_pin(b)
+        with self._lock:
+            if st.location is not None and self.nodelet_address:
+                try:
+                    target = (self.nodelet_address if st.location == "local"
+                              else st.location)
+                    self.client.send_oneway(target, "free_object", {"oid": b})
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------------ objects
+
+    def put(self, value) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("put() of an ObjectRef is not allowed")
+        oid = ObjectID.random()
+        b = oid.binary()
+        st = _Owned()
+        head_payload, views, total = ser.serialize(value)
+        if total <= INLINE_THRESHOLD or self.store is None:
+            buf = bytearray(total)
+            ser.write_into(memoryview(buf), head_payload, views)
+            st.inline = bytes(buf)
+        else:
+            try:
+                buf = self.store.create(b, total)
+                ser.write_into(buf, head_payload, views)
+                del buf
+                self.store.seal(b)
+                st.location = "local"
+                st.store_name = self.store.name
+            except Exception:
+                buf = bytearray(total)
+                ser.write_into(memoryview(buf), head_payload, views)
+                st.inline = bytes(buf)
+        st.value_cached = value
+        st.has_cached = True
+        st.event.set()
+        with self._lock:
+            self._owned[b] = st
+        return ObjectRef(oid, owner=self.address)
+
+    def get(self, refs: list[ObjectRef], timeout=None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        return [self._get_one(r, deadline) for r in refs]
+
+    def _remaining(self, deadline):
+        if deadline is None:
+            return None
+        rem = deadline - time.monotonic()
+        if rem <= 0:
+            raise exc.GetTimeoutError("get() timed out")
+        return rem
+
+    def _get_one(self, ref: ObjectRef, deadline):
+        b = ref.id.binary()
+        with self._lock:
+            st = self._owned.get(b)
+        if st is not None:
+            if not st.event.wait(self._remaining(deadline)):
+                raise exc.GetTimeoutError(f"get() timed out waiting for {ref}")
+            if st.error is not None:
+                raise st.error
+            if st.has_cached:
+                return st.value_cached
+            value = self._materialize(b, st.inline, st.location, st.store_name)
+            st.value_cached = value
+            st.has_cached = True
+            return value
+        # borrowed: ask the owner
+        owner = ref.owner
+        if owner is None or owner == self.address:
+            raise exc.ObjectLostError(f"no owner known for {ref}")
+        while True:
+            t = self._remaining(deadline)
+            try:
+                value, frames = self.client.call_frames(
+                    owner, "resolve", {"oid": b, "wait": True},
+                    timeout=min(t, 5.0) if t is not None else 5.0)
+            except PeerUnavailableError as e:
+                if "timed out" in str(e):
+                    continue  # owner alive but object pending; keep waiting
+                raise exc.OwnerDiedError(
+                    f"owner {owner} of {ref} is unreachable") from e
+            status = value["status"]
+            if status == "pending":
+                continue
+            if status == "error":
+                raise ser.loads_msg(frames[0])
+            if status == "inline":
+                return ser.deserialize(memoryview(frames[0]))
+            if status == "location":
+                return self._materialize(b, None, value["location"],
+                                         value.get("store_name"))
+            raise exc.ObjectLostError(f"{ref}: owner reports {status}")
+
+    def _materialize(self, oid: bytes, inline, location, store_name):
+        if inline is not None:
+            return ser.deserialize(memoryview(inline))
+        if self.store is not None and self.store.contains(oid):
+            return self._pinned_deserialize(oid)
+        if location in (None, "local"):
+            raise exc.ObjectLostError(f"object {oid.hex()[:12]} lost from store")
+        # pull through local nodelet into local store, then read zero-copy
+        if self.nodelet_address and self.store is not None:
+            r = self.client.call(self.nodelet_address, "fetch_object",
+                                 {"oid": oid, "location": location}, timeout=120)
+            if r.get("ok") and self.store.contains(oid):
+                return self._pinned_deserialize(oid)
+        # last resort: direct pull into memory
+        value, frames = self.client.call_frames(location, "pull_object",
+                                                {"oid": oid}, timeout=120)
+        if not value.get("ok"):
+            raise exc.ObjectLostError(f"object {oid.hex()[:12]}: "
+                                      f"{value.get('error')}")
+        return ser.deserialize(memoryview(frames[0]))
+
+    def _pinned_deserialize(self, oid: bytes):
+        """Read an object zero-copy out of the local store. If the
+        deserialized graph references out-of-band buffers (numpy/jax
+        arrays aliasing store memory), keep the store refcount held so
+        the region cannot be evicted or reused under the value."""
+        view = self.store.get(oid)
+        if view is None:
+            raise exc.ObjectLostError(f"object {oid.hex()[:12]} vanished")
+        value, n_oob = ser.deserialize_info(view)
+        if n_oob == 0:
+            del view
+            self.store.release(oid)
+        else:
+            with self._lock:
+                if oid in self._pins:
+                    del view
+                    self.store.release(oid)  # already pinned once
+                else:
+                    self._pins[oid] = view
+        return value
+
+    def _release_pin(self, oid: bytes):
+        with self._lock:
+            view = self._pins.pop(oid, None)
+        if view is not None:
+            del view
+            self.store.release(oid)
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = list(refs)
+        ready = []
+        while True:
+            still = []
+            for r in pending:
+                if self._is_ready(r):
+                    ready.append(r)
+                else:
+                    still.append(r)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.005)
+        return ready, pending
+
+    def _is_ready(self, ref: ObjectRef) -> bool:
+        b = ref.id.binary()
+        with self._lock:
+            st = self._owned.get(b)
+        if st is not None:
+            return st.event.is_set()
+        try:
+            value = self.client.call(ref.owner, "resolve",
+                                     {"oid": b, "wait": False}, timeout=5)
+            return value["status"] != "pending"
+        except Exception:
+            return False
+
+    def as_future(self, ref: ObjectRef):
+        import concurrent.futures as cf
+
+        fut = cf.Future()
+
+        def waiter():
+            try:
+                fut.set_result(self._get_one(ref, None))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=waiter, daemon=True).start()
+        return fut
+
+    # -- owner-side handlers --------------------------------------------------
+
+    def _h_resolve(self, msg, frames):
+        b = msg["oid"]
+        with self._lock:
+            st = self._owned.get(b)
+        if st is None:
+            return {"status": "unknown"}
+        if msg.get("wait", True):
+            st.event.wait(timeout=4.5)
+        if not st.event.is_set():
+            return {"status": "pending"}
+        st.served_borrow = True
+        if st.error is not None:
+            return {"status": "error"}, [ser.dumps_msg(st.error)]
+        if st.inline is not None:
+            return {"status": "inline"}, [st.inline]
+        if st.location == "local":
+            # owner-local store: hand out bytes directly (borrower may be
+            # anywhere; its nodelet pulls from our nodelet)
+            return {"status": "location", "location": self.nodelet_address,
+                    "store_name": self.store_name_of(st)}
+        return {"status": "location", "location": st.location,
+                "store_name": st.store_name}
+
+    def store_name_of(self, st):
+        return self.store.name if self.store is not None else st.store_name
+
+    def _h_task_done(self, msg, frames):
+        oids = msg["oids"]
+        task_id = msg.get("task_id") or b""
+        err_blob = msg.get("error")
+        if err_blob is not None:
+            try:
+                error = ser.loads_msg(err_blob)
+            except Exception:  # noqa: BLE001
+                error = exc.TaskError(RuntimeError("undecodable remote error"))
+            retryable = msg.get("retryable", False)
+            retried = self._task_failed(oids, error, retryable)
+            if not retried and task_id:
+                self._unpin_task_args(task_id)
+            return
+        if task_id:
+            self._unpin_task_args(task_id)
+        locations = msg.get("locations", [])
+        for i, b in enumerate(oids):
+            with self._lock:
+                st = self._owned.get(b)
+            if st is None:
+                continue
+            loc = locations[i] if i < len(locations) else None
+            if loc is None:
+                st.inline = frames[i] if i < len(frames) else None
+            else:
+                st.location = loc["address"]
+                st.store_name = loc.get("store_name")
+            st.event.set()
+
+    def _task_failed(self, oids, error, retryable) -> bool:
+        spec = None
+        with self._lock:
+            for b in oids:
+                st = self._owned.get(b)
+                if st is not None and st.spec is not None:
+                    spec = st.spec
+                    break
+        if spec is not None and retryable:
+            with self._lock:
+                st0 = self._owned.get(spec.return_oids[0])
+                can_retry = st0 is not None and st0.retries_left > 0 and \
+                    not st0.cancelled
+                if can_retry:
+                    for b in spec.return_oids:
+                        s = self._owned.get(b)
+                        if s is not None:
+                            s.retries_left -= 1
+            if can_retry:
+                try:
+                    self.client.call(self.nodelet_address, "schedule_task",
+                                     {"spec": dataclass_dict(spec)}, timeout=30)
+                    return True
+                except Exception:
+                    pass
+        for b in oids:
+            with self._lock:
+                st = self._owned.get(b)
+            if st is not None:
+                st.error = error
+                st.event.set()
+        return False
+
+    def _h_pubsub(self, msg, frames):
+        if msg.get("topic") == "actor":
+            data = msg["data"]
+            aid = bytes.fromhex(data["actor_id"])
+            with self._lock:
+                if data["event"] in ("dead", "restarting"):
+                    self._actor_addr.pop(aid, None)
+                elif data["event"] == "ready":
+                    self._actor_addr[aid] = data["address"]
+            if data["event"] == "dead":
+                self._unpin_task_args(aid)
+
+    # ------------------------------------------------------------ tasks
+
+    def _export_fn(self, fn) -> str:
+        blob = cloudpickle.dumps(fn)
+        fn_id = hashlib.sha1(blob).hexdigest()
+        if fn_id not in self._exported_fns:
+            self.client.call(self.head_address, "kv_put",
+                             {"ns": "fn", "key": fn_id, "overwrite": False},
+                             frames=[blob], timeout=30, retries=2)
+            self._exported_fns.add(fn_id)
+            self._fn_cache[fn_id] = fn
+        return fn_id
+
+    def _fetch_fn(self, fn_id: str) -> Callable:
+        fn = self._fn_cache.get(fn_id)
+        if fn is None:
+            value, frames = self.client.call_frames(
+                self.head_address, "kv_get", {"ns": "fn", "key": fn_id},
+                timeout=30, retries=2)
+            if not value.get("found"):
+                raise exc.RayTpuError(f"function {fn_id} not found in KV")
+            fn = cloudpickle.loads(frames[0])
+            self._fn_cache[fn_id] = fn
+        return fn
+
+    def _encode_args(self, args, kwargs):
+        ref_oids: list[bytes] = []
+
+        def enc(v):
+            if isinstance(v, ObjectRef):
+                ref_oids.append(v.id.binary())
+                return RefArg(v.id.binary(), v.owner or self.address)
+            return v
+
+        eargs = tuple(enc(a) for a in args)
+        ekwargs = {k: enc(v) for k, v in kwargs.items()}
+        return eargs, ekwargs, ref_oids
+
+    def _pin_task_args(self, task_id: bytes, ref_oids: list[bytes]):
+        if not ref_oids:
+            return
+        for b in ref_oids:
+            self._incref(b)
+        with self._lock:
+            self._task_arg_refs[task_id] = ref_oids
+
+    def _unpin_task_args(self, task_id: bytes):
+        with self._lock:
+            oids = self._task_arg_refs.pop(task_id, None)
+        for b in oids or ():
+            self._decref(b)
+
+    def submit_task(self, fn, args, kwargs, opts: TaskOptions):
+        n = opts.num_returns
+        oids = [ObjectID.random() for _ in range(n)]
+        fn_id = self._export_fn(fn)
+        eargs, ekwargs, ref_oids = self._encode_args(args, kwargs)
+        pg = opts.placement_group
+        pg_id = pg.id.binary() if pg is not None else None
+        spec = TaskSpec(
+            task_id=TaskID.random().binary(),
+            name=opts.name or getattr(fn, "__name__", "task"),
+            fn_id=fn_id,
+            args=eargs,
+            kwargs=ekwargs,
+            return_oids=[o.binary() for o in oids],
+            owner=self.address,
+            resources=opts.resource_request(),
+            max_retries=opts.max_retries,
+            retry_exceptions=opts.retry_exceptions,
+            placement_group=pg_id,
+            bundle_index=opts.placement_group_bundle_index,
+            label_selector=opts.label_selector,
+        )
+        with self._lock:
+            for o in oids:
+                self._owned[o.binary()] = _Owned(spec=spec,
+                                                retries_left=opts.max_retries)
+        self._pin_task_args(spec.task_id, ref_oids)
+        target = self.nodelet_address
+        if pg_id is not None:
+            target = self._pg_node_address(pg_id, opts.placement_group_bundle_index,
+                                           spec.resources) or target
+        self.client.call(target, "schedule_task", {"spec": dataclass_dict(spec)},
+                         timeout=60, retries=2)
+        refs = [ObjectRef(o, owner=self.address) for o in oids]
+        if n == 0:
+            return []
+        return refs[0] if n == 1 else refs
+
+    def _pg_node_address(self, pg_id: bytes, bundle_index: int, resources):
+        try:
+            info = self.client.call(self.head_address, "pg_table",
+                                    {"pg_id": pg_id}, timeout=10)
+            if info.get("state") != "CREATED":
+                return None
+            nodes = info["nodes"]
+            idx = bundle_index if 0 <= bundle_index < len(nodes) else 0
+            target_node = bytes.fromhex(nodes[idx])
+            view = self.client.call(self.head_address, "cluster_view", {},
+                                    timeout=10)
+            for nd in view["nodes"]:
+                if nd["node_id"] == target_node:
+                    return nd["address"]
+        except Exception:
+            return None
+        return None
+
+    def cancel(self, ref: ObjectRef, force=False, recursive=True):
+        with self._lock:
+            st = self._owned.get(ref.id.binary())
+            if st is not None:
+                st.cancelled = True
+                st.retries_left = 0
+
+    # ------------------------------------------------------------ actors
+
+    def create_actor(self, cls, args, kwargs, opts: ActorOptions) -> ActorHandle:
+        aid = ActorID.random()
+        eargs, ekwargs, ref_oids = self._encode_args(args, kwargs)
+        # init-arg refs stay pinned for the actor's lifetime (restarts
+        # re-resolve them); unpinned when the actor is reported dead.
+        self._pin_task_args(aid.binary(), ref_oids)
+        pg = opts.placement_group
+        spec = ActorSpec(
+            actor_id=aid.binary(),
+            cls_blob=b"",
+            args=eargs,
+            kwargs=ekwargs,
+            name=opts.name,
+            namespace=opts.namespace or self.namespace,
+            owner=self.address,
+            resources=opts.resource_request(),
+            max_restarts=opts.max_restarts,
+            max_concurrency=opts.max_concurrency,
+            lifetime=opts.lifetime,
+            placement_group=pg.id.binary() if pg is not None else None,
+            bundle_index=opts.placement_group_bundle_index,
+            label_selector=opts.label_selector,
+        )
+        blob = cloudpickle.dumps(cls)
+        r = self.client.call(self.head_address, "create_actor",
+                             {"spec": dataclass_dict(spec),
+                              "get_if_exists": opts.get_if_exists},
+                             frames=[blob], timeout=60)
+        actor_id = ActorID(r["actor_id"])
+        meta = {}
+        for mname in dir(cls):
+            m = getattr(cls, mname, None)
+            if callable(m) and hasattr(m, "__ray_tpu_method_options__"):
+                meta[mname] = m.__ray_tpu_method_options__
+        with self._lock:
+            self._actor_meta[actor_id.binary()] = meta
+        return ActorHandle(actor_id, meta)
+
+    def _resolve_actor(self, actor_id: bytes, timeout=60.0) -> str:
+        with self._lock:
+            addr = self._actor_addr.get(actor_id)
+        if addr is not None:
+            return addr
+        r = self.client.call(self.head_address, "get_actor",
+                             {"actor_id": actor_id, "wait": True,
+                              "timeout": timeout}, timeout=timeout + 10)
+        if r["state"] == "ALIVE":
+            with self._lock:
+                self._actor_addr[actor_id] = r["address"]
+            return r["address"]
+        if r["state"] == "UNKNOWN":
+            raise exc.ActorDiedError("no such actor")
+        if r["state"] == "DEAD":
+            raise exc.ActorDiedError(r.get("cause") or "actor is dead")
+        raise exc.ActorUnavailableError(
+            f"actor {actor_id.hex()[:12]} not ready ({r['state']})")
+
+    def submit_actor_task(self, actor_id: ActorID, mname: str, args, kwargs,
+                          mopts: dict):
+        n = int(mopts.get("num_returns", 1))
+        oids = [ObjectID.random() for _ in range(n)]
+        eargs, ekwargs, ref_oids = self._encode_args(args, kwargs)
+        ab = actor_id.binary()
+        task_id = TaskID.random().binary()
+        with self._lock:
+            for o in oids:
+                self._owned[o.binary()] = _Owned()
+        self._pin_task_args(task_id, ref_oids)
+        msg = {
+            "actor_id": ab,
+            "task_id": task_id,
+            "method": mname,
+            "args": eargs,
+            "kwargs": ekwargs,
+            "oids": [o.binary() for o in oids],
+            "owner": self.address,
+        }
+        last_err = None
+        for attempt in range(3):
+            try:
+                addr = self._resolve_actor(ab)
+            except exc.RayTpuError as e:
+                self._error_oids([o.binary() for o in oids], e)
+                self._unpin_task_args(task_id)
+                break
+            try:
+                self.client.call(addr, "actor_call", msg, timeout=30)
+                last_err = None
+                break
+            except PeerUnavailableError as e:
+                last_err = e
+                with self._lock:
+                    self._actor_addr.pop(ab, None)  # force re-resolve
+                time.sleep(0.2)
+        else:
+            pass
+        if last_err is not None:
+            self._error_oids(
+                [o.binary() for o in oids],
+                exc.ActorUnavailableError(f"actor unreachable: {last_err}"))
+            self._unpin_task_args(task_id)
+        refs = [ObjectRef(o, owner=self.address) for o in oids]
+        return refs[0] if n == 1 else refs
+
+    def _error_oids(self, oids, error):
+        for b in oids:
+            with self._lock:
+                st = self._owned.get(b)
+            if st is not None:
+                st.error = error
+                st.event.set()
+
+    def kill_actor(self, actor_id: ActorID, no_restart=True):
+        self.client.call(self.head_address, "kill_actor",
+                         {"actor_id": actor_id.binary(),
+                          "no_restart": no_restart}, timeout=30)
+
+    def get_named_actor(self, name: str, namespace=None) -> ActorHandle:
+        r = self.client.call(self.head_address, "get_named_actor",
+                             {"name": name,
+                              "namespace": namespace or self.namespace},
+                             timeout=30)
+        if not r.get("found"):
+            raise ValueError(f"no live actor named {name!r}")
+        aid = ActorID(r["actor_id"])
+        with self._lock:
+            meta = self._actor_meta.get(aid.binary(), {})
+        return ActorHandle(aid, meta)
+
+    # ------------------------------------------------------------ cluster info
+
+    def nodes(self):
+        view = self.client.call(self.head_address, "cluster_view", {}, timeout=10)
+        return [
+            {
+                "NodeID": n["node_id"].hex(),
+                "Alive": n["alive"],
+                "Resources": n["resources"],
+                "Available": n["available"],
+                "Labels": n["labels"],
+                "NodeManagerAddress": n["address"],
+            }
+            for n in view["nodes"]
+        ]
+
+    def cluster_resources(self):
+        out: dict[str, float] = {}
+        for n in self.nodes():
+            if not n["Alive"]:
+                continue
+            for r, q in n["Resources"].items():
+                out[r] = out.get(r, 0.0) + q
+        return out
+
+    def available_resources(self):
+        out: dict[str, float] = {}
+        for n in self.nodes():
+            if not n["Alive"]:
+                continue
+            for r, q in n["Available"].items():
+                out[r] = out.get(r, 0.0) + q
+        return out
+
+    def runtime_context(self):
+        from ray_tpu.core.runtime_context import RuntimeContext
+
+        return RuntimeContext(
+            job_id=self.job_id,
+            node_id=self.node_id,
+            worker_id=self.worker_id,
+            actor_id=self._ctx.actor_id,
+            task_id=self._ctx.task_id,
+            namespace=self.namespace,
+        )
+
+    def timeline(self, filename=None):
+        return self._events.chrome_trace(filename)
+
+    def context_info(self):
+        return {"head_address": self.head_address, "node_id":
+                self.node_id.hex() if self.node_id else None,
+                "local_mode": False}
+
+    def shutdown(self):
+        if self._shutdown_flag:
+            return
+        self._shutdown_flag = True
+        atexit.unregister(self.shutdown)
+        self.server.stop()
+        for oid in list(self._pins):
+            self._release_pin(oid)
+        for svc in reversed(self._booted):
+            try:
+                svc.stop()
+            except Exception:
+                pass
+        self._booted.clear()
+        if getattr(self, "store", None) is not None:
+            try:
+                self.store.close()
+            except Exception:
+                pass
+        # NOTE: the shared RpcClient is intentionally left alive — other
+        # in-process services (test Cluster fixtures, a second init())
+        # share it; peers to dead addresses are harmless.
+
+
+def _detect_tpu_chips() -> int:
+    """TPU chip detection (reference: TPUAcceleratorManager,
+    python/ray/_private/accelerators/tpu.py:98-115 — /dev/accel* and
+    vfio device files)."""
+    import glob
+
+    n = len(glob.glob("/dev/accel*"))
+    if n == 0:
+        n = len(glob.glob("/dev/vfio/*")) - (1 if os.path.exists("/dev/vfio/vfio")
+                                             else 0)
+        n = max(0, n)
+    env = os.environ.get("RAY_TPU_NUM_CHIPS")
+    if env:
+        try:
+            n = int(env)
+        except ValueError:
+            pass
+    return n

@@ -1,0 +1,416 @@
+"""Head service — the cluster control plane.
+
+Reference parity: the GCS server (src/ray/gcs/gcs_server/gcs_server.h:89)
+composed of node manager, actor manager/scheduler, KV, pubsub and health
+checks. Matching the reference's key design fact: the head is NOT on the
+task hot path — tasks flow driver→nodelet→worker and results flow
+worker→owner directly; the head only sees node membership, actor
+lifecycle, the function/KV store, and placement groups.
+
+Runs either embedded in the driver process tree (ray_tpu.init() local
+boot) or standalone via `python -m ray_tpu.core.head`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ray_tpu.core import serialization as ser
+from ray_tpu.core.rpc import RpcClient, RpcServer
+from ray_tpu.core.specs import ActorSpec, NodeInfo
+
+HEARTBEAT_INTERVAL_S = 0.5
+NODE_DEATH_AFTER_S = 5.0
+
+
+class ActorState:
+    PENDING = "PENDING"
+    ALIVE = "ALIVE"
+    RESTARTING = "RESTARTING"
+    DEAD = "DEAD"
+
+
+class _ActorRecord:
+    __slots__ = ("spec", "state", "address", "node_id", "restarts_left",
+                 "death_cause", "cond")
+
+    def __init__(self, spec: ActorSpec):
+        self.spec = spec
+        self.state = ActorState.PENDING
+        self.address = None
+        self.node_id = None
+        self.restarts_left = spec.max_restarts
+        self.death_cause = ""
+        self.cond = threading.Condition()
+
+
+class Head:
+    def __init__(self, session_name: str = "session"):
+        self.server = RpcServer(name="head", num_threads=32)
+        self.address = self.server.address
+        self.client = RpcClient.shared()
+        self.session_name = session_name
+
+        self._lock = threading.RLock()
+        self._nodes: dict[bytes, NodeInfo] = {}
+        self._available: dict[bytes, dict] = {}
+        self._last_beat: dict[bytes, float] = {}
+        self._kv: dict[str, dict[bytes, bytes]] = {}
+        self._actors: dict[bytes, _ActorRecord] = {}
+        self._named: dict[tuple[str, str], bytes] = {}
+        self._subs: dict[str, set[str]] = {}  # topic -> subscriber addresses
+        self._pgs = {}  # placement groups: pg_id -> record (see placement.py)
+        self._stopped = threading.Event()
+
+        s = self.server
+        s.register("register_node", self._h_register_node)
+        s.register("heartbeat", self._h_heartbeat, oneway=True)
+        s.register("cluster_view", self._h_cluster_view)
+        s.register("kv_put", self._h_kv_put)
+        s.register("kv_get", self._h_kv_get)
+        s.register("kv_del", self._h_kv_del)
+        s.register("kv_keys", self._h_kv_keys)
+        s.register("create_actor", self._h_create_actor)
+        s.register("actor_ready", self._h_actor_ready, oneway=True)
+        s.register("actor_died", self._h_actor_died)
+        s.register("get_actor", self._h_get_actor)
+        s.register("get_named_actor", self._h_get_named_actor)
+        s.register("kill_actor", self._h_kill_actor)
+        s.register("subscribe", self._h_subscribe)
+        s.register("publish", self._h_publish, oneway=True)
+        s.register("create_pg", self._h_create_pg)
+        s.register("pg_table", self._h_pg_table)
+        s.register("remove_pg", self._h_remove_pg)
+        s.register("ping", lambda m, f: "pong")
+        self._monitor = threading.Thread(target=self._monitor_loop, daemon=True,
+                                         name="head-monitor")
+
+    def start(self):
+        self.server.start()
+        self._monitor.start()
+        return self
+
+    def stop(self):
+        self._stopped.set()
+        self.server.stop()
+
+    # ------------------------------------------------------------ nodes
+
+    def _h_register_node(self, msg, frames):
+        info = NodeInfo(**msg["node"])
+        with self._lock:
+            self._nodes[info.node_id] = info
+            self._available[info.node_id] = dict(info.resources)
+            self._last_beat[info.node_id] = time.monotonic()
+        self._publish("node", {"event": "added", "node_id": info.node_id.hex()})
+        return {"num_nodes": len(self._nodes)}
+
+    def _h_heartbeat(self, msg, frames):
+        nid = msg["node_id"]
+        with self._lock:
+            if nid in self._nodes:
+                self._last_beat[nid] = time.monotonic()
+                self._available[nid] = msg["available"]
+                self._nodes[nid].alive = True
+
+    def _h_cluster_view(self, msg, frames):
+        with self._lock:
+            return {
+                "nodes": [
+                    {
+                        "node_id": n.node_id,
+                        "address": n.address,
+                        "resources": n.resources,
+                        "available": self._available.get(n.node_id, {}),
+                        "labels": n.labels,
+                        "store_name": n.store_name,
+                        "alive": n.alive,
+                    }
+                    for n in self._nodes.values()
+                ]
+            }
+
+    def _monitor_loop(self):
+        """Health checks (reference: gcs_health_check_manager.h:45 — the
+        GCS probes nodes; here nodes push heartbeats and we age them)."""
+        while not self._stopped.wait(HEARTBEAT_INTERVAL_S):
+            now = time.monotonic()
+            dead = []
+            with self._lock:
+                for nid, info in self._nodes.items():
+                    if info.alive and now - self._last_beat.get(nid, 0) > NODE_DEATH_AFTER_S:
+                        info.alive = False
+                        dead.append(nid)
+            for nid in dead:
+                self._on_node_death(nid)
+
+    def _on_node_death(self, node_id: bytes):
+        self._publish("node", {"event": "removed", "node_id": node_id.hex()})
+        # Actors on the dead node die (and maybe restart elsewhere):
+        with self._lock:
+            affected = [r for r in self._actors.values()
+                        if r.node_id == node_id and r.state == ActorState.ALIVE]
+        for rec in affected:
+            self._actor_died(rec, f"node {node_id.hex()[:12]} died")
+
+    # ------------------------------------------------------------ kv
+
+    def _h_kv_put(self, msg, frames):
+        ns = msg.get("ns", "default")
+        with self._lock:
+            table = self._kv.setdefault(ns, {})
+            exists = msg["key"] in table
+            if msg.get("overwrite", True) or not exists:
+                table[msg["key"]] = frames[0] if frames else msg.get("value", b"")
+        return {"added": not exists}
+
+    def _h_kv_get(self, msg, frames):
+        with self._lock:
+            v = self._kv.get(msg.get("ns", "default"), {}).get(msg["key"])
+        return ({"found": v is not None}, [v] if v is not None else [])
+
+    def _h_kv_del(self, msg, frames):
+        with self._lock:
+            return {"deleted": self._kv.get(msg.get("ns", "default"), {})
+                    .pop(msg["key"], None) is not None}
+
+    def _h_kv_keys(self, msg, frames):
+        prefix = msg.get("prefix", b"")
+        with self._lock:
+            return {"keys": [k for k in self._kv.get(msg.get("ns", "default"), {})
+                             if k.startswith(prefix)]}
+
+    # ------------------------------------------------------------ actors
+
+    def _h_create_actor(self, msg, frames):
+        spec = ActorSpec(**msg["spec"])
+        spec.cls_blob = frames[0] if frames else spec.cls_blob
+        with self._lock:
+            if spec.name:
+                key = (spec.namespace, spec.name)
+                existing = self._named.get(key)
+                if existing is not None:
+                    rec = self._actors.get(existing)
+                    if rec is not None and rec.state != ActorState.DEAD:
+                        if msg.get("get_if_exists"):
+                            return {"actor_id": existing, "existing": True}
+                        raise ValueError(f"actor name {spec.name!r} already taken")
+                self._named[key] = spec.actor_id
+            self._actors[spec.actor_id] = _ActorRecord(spec)
+        self._schedule_actor(self._actors[spec.actor_id])
+        return {"actor_id": spec.actor_id, "existing": False}
+
+    def _pick_node(self, resources: dict, pg: bytes | None = None,
+                   bundle_index: int = -1, label_selector: dict | None = None):
+        """Best-fit placement over the freshest resource view (reference:
+        GcsActorScheduler / hybrid policy; simplified to best-fit since
+        nodelets do their own local queueing)."""
+        from ray_tpu.core.placement import pg_bundle_node
+        with self._lock:
+            if pg is not None:
+                nid = pg_bundle_node(self._pgs, pg, bundle_index, resources)
+                if nid is not None and nid in self._nodes and self._nodes[nid].alive:
+                    return self._nodes[nid]
+                return None
+            best, best_score = None, None
+            for n in self._nodes.values():
+                if not n.alive:
+                    continue
+                if label_selector and any(n.labels.get(k) != v
+                                          for k, v in label_selector.items()):
+                    continue
+                avail = self._available.get(n.node_id, {})
+                total = n.resources
+                if any(total.get(r, 0.0) < q for r, q in resources.items()):
+                    continue  # infeasible on this node
+                free = sum(min(avail.get(r, 0.0) / q, 10.0)
+                           for r, q in resources.items() if q) if resources else \
+                    sum(avail.values())
+                if best_score is None or free > best_score:
+                    best, best_score = n, free
+            return best
+
+    def _schedule_actor(self, rec: _ActorRecord):
+        node = self._pick_node(rec.spec.resources, rec.spec.placement_group,
+                               rec.spec.bundle_index, rec.spec.label_selector)
+        if node is None:
+            # no feasible node right now: retry in the background
+            def retry():
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline and not self._stopped.is_set():
+                    time.sleep(0.2)
+                    n = self._pick_node(rec.spec.resources, rec.spec.placement_group,
+                                        rec.spec.bundle_index, rec.spec.label_selector)
+                    if n is not None:
+                        self._send_start(rec, n)
+                        return
+                self._actor_died(rec, "no feasible node for actor resources "
+                                 f"{rec.spec.resources}", allow_restart=False)
+
+            threading.Thread(target=retry, daemon=True).start()
+            return
+        self._send_start(rec, node)
+
+    def _send_start(self, rec: _ActorRecord, node: NodeInfo):
+        with self._lock:
+            rec.node_id = node.node_id
+        try:
+            self.client.call(node.address, "start_actor",
+                             {"spec": dataclass_dict(rec.spec)},
+                             frames=[rec.spec.cls_blob], timeout=60)
+        except Exception as e:  # noqa: BLE001
+            self._actor_died(rec, f"failed to start on node: {e}")
+
+    def _h_actor_ready(self, msg, frames):
+        with self._lock:
+            rec = self._actors.get(msg["actor_id"])
+        if rec is None:
+            return
+        with rec.cond:
+            rec.state = ActorState.ALIVE
+            rec.address = msg["address"]
+            rec.cond.notify_all()
+        self._publish("actor", {"event": "ready", "actor_id": msg["actor_id"].hex(),
+                                "address": msg["address"]})
+
+    def _h_actor_died(self, msg, frames):
+        with self._lock:
+            rec = self._actors.get(msg["actor_id"])
+        if rec is not None:
+            self._actor_died(rec, msg.get("cause", "worker died"),
+                             allow_restart=not msg.get("no_restart", False))
+        return {}
+
+    def _actor_died(self, rec: _ActorRecord, cause: str, allow_restart: bool = True):
+        with rec.cond:
+            if rec.state == ActorState.DEAD:
+                return
+            restart = allow_restart and rec.restarts_left != 0
+            if restart:
+                if rec.restarts_left > 0:
+                    rec.restarts_left -= 1
+                rec.state = ActorState.RESTARTING
+                rec.address = None
+            else:
+                rec.state = ActorState.DEAD
+                rec.death_cause = cause
+            rec.cond.notify_all()
+        self._publish("actor", {"event": "restarting" if restart else "dead",
+                                "actor_id": rec.spec.actor_id.hex(), "cause": cause})
+        if restart:
+            self._schedule_actor(rec)
+
+    def _h_get_actor(self, msg, frames):
+        aid = msg["actor_id"]
+        timeout = msg.get("timeout", 60.0)
+        with self._lock:
+            rec = self._actors.get(aid)
+        if rec is None:
+            return {"state": "UNKNOWN"}
+        deadline = time.monotonic() + timeout
+        with rec.cond:
+            while rec.state in (ActorState.PENDING, ActorState.RESTARTING):
+                if not msg.get("wait", True):
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                rec.cond.wait(remaining)
+            return {"state": rec.state, "address": rec.address,
+                    "cause": rec.death_cause}
+
+    def _h_get_named_actor(self, msg, frames):
+        key = (msg.get("namespace", "default"), msg["name"])
+        with self._lock:
+            aid = self._named.get(key)
+            rec = self._actors.get(aid) if aid else None
+            if rec is None or rec.state == ActorState.DEAD:
+                return {"found": False}
+        return {"found": True, "actor_id": aid}
+
+    def _h_kill_actor(self, msg, frames):
+        with self._lock:
+            rec = self._actors.get(msg["actor_id"])
+        if rec is None:
+            return {}
+        no_restart = msg.get("no_restart", True)
+        node = self._nodes.get(rec.node_id) if rec.node_id else None
+        if node is not None:
+            try:
+                self.client.call(node.address, "stop_actor",
+                                 {"actor_id": msg["actor_id"]}, timeout=10)
+            except Exception:
+                pass
+        self._actor_died(rec, "killed via ray_tpu.kill()",
+                         allow_restart=not no_restart)
+        return {}
+
+    # ------------------------------------------------------------ pubsub
+
+    def _h_subscribe(self, msg, frames):
+        with self._lock:
+            for t in msg["topics"]:
+                self._subs.setdefault(t, set()).add(msg["address"])
+        return {}
+
+    def _h_publish(self, msg, frames):
+        self._publish(msg["topic"], msg["data"])
+
+    def _publish(self, topic: str, data: dict):
+        with self._lock:
+            subs = list(self._subs.get(topic, ()))
+        for addr in subs:
+            try:
+                self.client.send_oneway(addr, "pubsub", {"topic": topic, "data": data})
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------ placement groups
+
+    def _h_create_pg(self, msg, frames):
+        from ray_tpu.core.placement import create_pg
+        with self._lock:
+            nodes = [n for n in self._nodes.values() if n.alive]
+            avail = dict(self._available)
+        return create_pg(self, self._pgs, msg, nodes, avail)
+
+    def _h_pg_table(self, msg, frames):
+        from ray_tpu.core.placement import pg_info
+        with self._lock:
+            return pg_info(self._pgs, msg.get("pg_id"))
+
+    def _h_remove_pg(self, msg, frames):
+        from ray_tpu.core.placement import remove_pg
+        return remove_pg(self, self._pgs, msg["pg_id"])
+
+
+def dataclass_dict(dc) -> dict:
+    import dataclasses
+    return {f.name: getattr(dc, f.name) for f in dataclasses.fields(dc)}
+
+
+def main():
+    import argparse
+    import os
+    import sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--address-file", required=True)
+    args = ap.parse_args()
+    head = Head().start()
+    tmp = args.address_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(head.address)
+    os.replace(tmp, args.address_file)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    head.stop()
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,546 @@
+"""Nodelet — the per-node agent.
+
+Reference parity: the raylet (src/ray/raylet/raylet.h, node_manager.h:117)
+composed of: WorkerPool (worker_pool.h:216 — spawn/cache worker
+processes), local scheduling with resource instances
+(local_task_manager.h:58 — dispatch loop + spillback), the local object
+store host, and node→node object transfer (object_manager.h:117 pull
+protocol). One nodelet per node; it owns the shm object-store segment
+that all local workers map.
+
+Scheduling follows the reference's two-level design: submitters send
+tasks to a nodelet; the nodelet either dispatches locally (resources +
+an idle/new worker) or spills to the best other node using the cluster
+view gossiped via head heartbeats (hybrid policy:
+raylet/scheduling/policy/hybrid_scheduling_policy.h:50 — prefer local
+until saturated, then best-fit remote).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+
+from ray_tpu.core import serialization as ser
+from ray_tpu.core.head import HEARTBEAT_INTERVAL_S, dataclass_dict
+from ray_tpu.core.object_store import open_store
+from ray_tpu.core.rpc import RpcClient, RpcServer
+from ray_tpu.core.specs import ActorSpec, TaskSpec
+
+MAX_SPILLBACKS = 4
+
+
+class _Worker:
+    __slots__ = ("worker_id", "proc", "address", "idle", "current_task",
+                 "actor_id", "ready")
+
+    def __init__(self, worker_id: bytes, proc):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.address = None
+        self.idle = False
+        self.current_task = None  # TaskSpec being executed
+        self.actor_id = None  # set for dedicated actor workers
+        self.ready = threading.Event()
+
+
+class Nodelet:
+    def __init__(self, head_address: str, resources: dict[str, float],
+                 labels: dict[str, str] | None = None,
+                 session_dir: str = "/tmp/ray_tpu",
+                 store_capacity: int | None = None,
+                 node_id: bytes | None = None):
+        from ray_tpu.core.ids import NodeID
+
+        self.node_id = node_id or NodeID.random().binary()
+        self.head_address = head_address
+        self.resources = dict(resources)
+        self.labels = dict(labels or {})
+        self.session_dir = session_dir
+        self.log_dir = os.path.join(session_dir, "logs")
+        os.makedirs(self.log_dir, exist_ok=True)
+
+        kw = {"capacity": store_capacity} if store_capacity else {}
+        self.store = open_store(**kw)
+        self.client = RpcClient.shared()
+        self.server = RpcServer(name="nodelet", num_threads=32)
+        self.address = self.server.address
+
+        self._lock = threading.RLock()
+        self._available = dict(self.resources)
+        self._queue: deque[TaskSpec] = deque()
+        self._workers: dict[bytes, _Worker] = {}
+        self._idle_workers: deque[_Worker] = deque()
+        self._bundles: dict[tuple, dict] = {}  # (pg_id, idx) -> resources
+        self._cluster_view = []
+        self._view_ts = 0.0
+        self._stopped = threading.Event()
+        self._dispatch_wake = threading.Event()
+
+        s = self.server
+        s.register("schedule_task", self._h_schedule_task)
+        s.register("start_actor", self._h_start_actor)
+        s.register("stop_actor", self._h_stop_actor)
+        s.register("worker_ready", self._h_worker_ready)
+        s.register("task_finished", self._h_task_finished, oneway=True)
+        s.register("fetch_object", self._h_fetch_object)
+        s.register("pull_object", self._h_pull_object)
+        s.register("free_object", self._h_free_object, oneway=True)
+        s.register("reserve_bundle", self._h_reserve_bundle)
+        s.register("release_bundle", self._h_release_bundle)
+        s.register("node_info", self._h_node_info)
+        s.register("ping", lambda m, f: "pong")
+
+        self._threads = [
+            threading.Thread(target=self._heartbeat_loop, daemon=True,
+                             name="nodelet-heartbeat"),
+            threading.Thread(target=self._dispatch_loop, daemon=True,
+                             name="nodelet-dispatch"),
+            threading.Thread(target=self._reap_loop, daemon=True,
+                             name="nodelet-reaper"),
+        ]
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self):
+        self.server.start()
+        self.client.call(self.head_address, "register_node", {
+            "node": {
+                "node_id": self.node_id,
+                "address": self.address,
+                "resources": self.resources,
+                "labels": self.labels,
+                "store_name": self.store.name,
+            }
+        }, timeout=30, retries=3)
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self):
+        self._stopped.set()
+        self._dispatch_wake.set()
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+        for w in workers:
+            try:
+                w.proc.wait(timeout=2)
+            except Exception:
+                try:
+                    w.proc.kill()
+                except Exception:
+                    pass
+        self.server.stop()
+        self.store.close()
+        self.store.unlink()
+
+    def _heartbeat_loop(self):
+        while not self._stopped.wait(HEARTBEAT_INTERVAL_S):
+            with self._lock:
+                avail = dict(self._available)
+            try:
+                self.client.send_oneway(self.head_address, "heartbeat",
+                                        {"node_id": self.node_id,
+                                         "available": avail})
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------ workers
+
+    def _spawn_worker(self, actor_spec_blob: bytes | None = None) -> _Worker:
+        from ray_tpu.core.ids import WorkerID
+
+        wid = WorkerID.random().binary()
+        env = dict(os.environ)
+        env["RAY_TPU_NODELET_ADDR"] = self.address
+        env["RAY_TPU_HEAD_ADDR"] = self.head_address
+        env["RAY_TPU_STORE_NAME"] = self.store.name
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        env["RAY_TPU_WORKER_ID"] = wid.hex()
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        # Workers must never grab the (single) TPU by default; tasks that
+        # need the chip opt in via resources (driver holds the device).
+        # Dropping the axon pool env also skips the sitecustomize jax
+        # import (~2s saved per worker spawn); the original value is
+        # preserved for workers that legitimately claim the TPU.
+        if "PALLAS_AXON_POOL_IPS" in env:
+            env["RAY_TPU_AXON_POOL_IPS"] = env.pop("PALLAS_AXON_POOL_IPS")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        log = open(os.path.join(self.log_dir, f"worker-{wid.hex()[:12]}.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            env=env, stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        w = _Worker(wid, proc)
+        with self._lock:
+            self._workers[wid] = w
+        return w
+
+    def _h_worker_ready(self, msg, frames):
+        with self._lock:
+            w = self._workers.get(msg["worker_id"])
+            if w is None:
+                return {}
+            w.address = msg["address"]
+            w.ready.set()
+            if w.actor_id is None and not w.idle and w.current_task is None:
+                w.idle = True
+                self._idle_workers.append(w)
+        self._dispatch_wake.set()
+        return {}
+
+    def _reap_loop(self):
+        """Detect worker-process death (reference: raylet learns of worker
+        death via socket disconnect; here we poll child processes)."""
+        while not self._stopped.wait(0.2):
+            dead = []
+            with self._lock:
+                for w in self._workers.values():
+                    if w.proc.poll() is not None:
+                        dead.append(w)
+                for w in dead:
+                    self._workers.pop(w.worker_id, None)
+                    if w in self._idle_workers:
+                        self._idle_workers.remove(w)
+            for w in dead:
+                self._on_worker_death(w)
+
+    def _on_worker_death(self, w: _Worker):
+        rc = w.proc.returncode
+        if w.current_task is not None:
+            spec = w.current_task
+            self._release(spec)
+            try:
+                self.client.send_oneway(spec.owner, "task_done", {
+                    "task_id": spec.task_id,
+                    "oids": spec.return_oids,
+                    "error": ser.dumps_msg(_worker_died_error(spec.name, rc)),
+                    "retryable": True,
+                })
+            except Exception:
+                pass
+        if w.actor_id is not None and not self._stopped.is_set():
+            try:
+                self.client.call(self.head_address, "actor_died",
+                                 {"actor_id": w.actor_id,
+                                  "cause": f"worker process exited (code {rc})"},
+                                 timeout=10)
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------ scheduling
+
+    def _h_schedule_task(self, msg, frames):
+        spec = TaskSpec(**msg["spec"])
+        target = self._place(spec)
+        if target == "local":
+            with self._lock:
+                self._queue.append(spec)
+            self._dispatch_wake.set()
+            return {"queued": "local"}
+        if target is None:
+            with self._lock:  # queue anyway; resources may appear
+                self._queue.append(spec)
+            self._dispatch_wake.set()
+            return {"queued": "infeasible-wait"}
+        # spillback (reference: normal_task_submitter.cc:451 retry at
+        # the raylet the scheduler pointed to)
+        spec.spillback_count += 1
+        self.client.call(target, "schedule_task",
+                         {"spec": dataclass_dict(spec)}, timeout=30)
+        return {"queued": "spilled"}
+
+    def _place(self, spec: TaskSpec):
+        """'local', a remote nodelet address, or None (nothing fits)."""
+        req = spec.resources
+        with self._lock:
+            if spec.placement_group is not None:
+                # PG tasks were routed here by the owner via pg_bundle_node;
+                # run them against the reservation.
+                return "local"
+            fits_total = all(self.resources.get(r, 0.0) >= q for r, q in req.items())
+            fits_now = all(self._available.get(r, 0.0) >= q for r, q in req.items())
+            queue_len = len(self._queue)
+        if fits_now or (fits_total and queue_len < 2) or \
+                spec.spillback_count >= MAX_SPILLBACKS:
+            return "local" if fits_total or spec.placement_group else None
+        # look for a better node
+        view = self._cluster_view_cached()
+        best, best_free = None, None
+        for n in view:
+            if n["node_id"] == self.node_id or not n["alive"]:
+                continue
+            total, avail = n["resources"], n["available"]
+            if any(total.get(r, 0.0) < q for r, q in req.items()):
+                continue
+            if any(avail.get(r, 0.0) < q for r, q in req.items()):
+                continue
+            free = sum(avail.values())
+            if best_free is None or free > best_free:
+                best, best_free = n, free
+        if best is not None:
+            return best["address"]
+        return "local" if fits_total else None
+
+    def _cluster_view_cached(self):
+        now = time.monotonic()
+        if now - self._view_ts > 1.0:
+            try:
+                view = self.client.call(self.head_address, "cluster_view", {},
+                                        timeout=5)
+                self._cluster_view = view["nodes"]
+                self._view_ts = now
+            except Exception:
+                pass
+        return self._cluster_view
+
+    def _can_run(self, req: dict) -> bool:
+        return all(self._available.get(r, 0.0) >= q for r, q in req.items())
+
+    def _acquire(self, spec: TaskSpec) -> bool:
+        req = {} if spec.placement_group is not None else spec.resources
+        with self._lock:
+            if not self._can_run(req):
+                return False
+            for r, q in req.items():
+                self._available[r] -= q
+            return True
+
+    def _release(self, spec: TaskSpec):
+        req = {} if spec.placement_group is not None else spec.resources
+        with self._lock:
+            for r, q in req.items():
+                self._available[r] = min(self.resources.get(r, 0.0),
+                                         self._available[r] + q)
+
+    def _dispatch_loop(self):
+        """The dispatch hot loop (reference:
+        LocalTaskManager::DispatchScheduledTasksToWorkers,
+        local_task_manager.cc:121)."""
+        while not self._stopped.is_set():
+            self._dispatch_wake.wait(timeout=0.05)
+            self._dispatch_wake.clear()
+            while True:
+                with self._lock:
+                    if not self._queue:
+                        break
+                    spec = self._queue[0]
+                    if not self._acquire(spec):
+                        break
+                    self._queue.popleft()
+                    w = None
+                    while self._idle_workers:
+                        cand = self._idle_workers.popleft()
+                        if cand.worker_id in self._workers:
+                            w = cand
+                            break
+                    if w is not None:
+                        w.idle = False
+                        w.current_task = spec
+                if w is None:
+                    w = self._spawn_worker()
+                    w.current_task = spec
+                threading.Thread(target=self._push_task, args=(w, spec),
+                                 daemon=True).start()
+
+    def _push_task(self, w: _Worker, spec: TaskSpec):
+        if not w.ready.wait(timeout=60):
+            self._requeue_or_fail(w, spec, "worker failed to start")
+            return
+        try:
+            self.client.send_oneway(w.address, "execute_task",
+                                    {"spec": dataclass_dict(spec)})
+        except Exception as e:  # noqa: BLE001
+            self._requeue_or_fail(w, spec, f"push failed: {e}")
+
+    def _requeue_or_fail(self, w: _Worker, spec: TaskSpec, cause: str):
+        self._release(spec)
+        w.current_task = None
+        try:
+            self.client.send_oneway(spec.owner, "task_done", {
+                "task_id": spec.task_id,
+                "oids": spec.return_oids,
+                "error": ser.dumps_msg(RuntimeError(cause)),
+                "retryable": True,
+            })
+        except Exception:
+            pass
+
+    def _h_task_finished(self, msg, frames):
+        with self._lock:
+            w = self._workers.get(msg["worker_id"])
+        if w is None:
+            return
+        spec = w.current_task
+        if spec is not None:
+            self._release(spec)
+        w.current_task = None
+        with self._lock:
+            if w.worker_id in self._workers and w.actor_id is None:
+                w.idle = True
+                self._idle_workers.append(w)
+        self._dispatch_wake.set()
+
+    # ------------------------------------------------------------ actors
+
+    def _h_start_actor(self, msg, frames):
+        spec = ActorSpec(**msg["spec"])
+        spec.cls_blob = frames[0] if frames else spec.cls_blob
+        req = {} if spec.placement_group is not None else spec.resources
+        with self._lock:
+            if not self._can_run(req):
+                raise RuntimeError(f"insufficient resources for actor: {req}")
+            for r, q in req.items():
+                self._available[r] -= q
+        w = self._spawn_worker()
+        w.actor_id = spec.actor_id
+
+        def push():
+            if not w.ready.wait(timeout=60):
+                try:
+                    self.client.call(self.head_address, "actor_died",
+                                     {"actor_id": spec.actor_id,
+                                      "cause": "actor worker failed to start"},
+                                     timeout=10)
+                except Exception:
+                    pass
+                return
+            self.client.send_oneway(w.address, "become_actor",
+                                    {"spec": dataclass_dict(spec)},
+                                    frames=[spec.cls_blob])
+
+        threading.Thread(target=push, daemon=True).start()
+        return {"ok": True}
+
+    def _h_stop_actor(self, msg, frames):
+        with self._lock:
+            target = next((w for w in self._workers.values()
+                           if w.actor_id == msg["actor_id"]), None)
+        if target is not None:
+            try:
+                target.proc.terminate()
+            except Exception:
+                pass
+        return {}
+
+    # ------------------------------------------------------------ objects
+
+    def _h_fetch_object(self, msg, frames):
+        """Ensure an object is present in the local store, pulling from
+        the node given in `location` if needed (reference: PullManager,
+        object_manager/pull_manager.h:52)."""
+        oid = msg["oid"]
+        if self.store.contains(oid):
+            return {"ok": True}
+        location = msg.get("location")
+        if not location:
+            return {"ok": False, "error": "no location"}
+        value, frames_in = self.client.call_frames(
+            location, "pull_object", {"oid": oid}, timeout=60, retries=2)
+        if not value.get("ok"):
+            return {"ok": False, "error": value.get("error", "pull failed")}
+        data = frames_in[0]
+        try:
+            self.store.put(oid, data)
+        except KeyError:
+            pass  # concurrent fetch won
+        return {"ok": True}
+
+    def _h_pull_object(self, msg, frames):
+        oid = msg["oid"]
+        v = self.store.get(oid)
+        if v is None:
+            return {"ok": False, "error": "absent"}
+        try:
+            return {"ok": True}, [bytes(v)]
+        finally:
+            del v
+            self.store.release(oid)
+
+    def _h_free_object(self, msg, frames):
+        try:
+            self.store.delete(msg["oid"])
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------ bundles
+
+    def _h_reserve_bundle(self, msg, frames):
+        req = msg["resources"]
+        key = (msg["pg_id"], msg["bundle_index"])
+        with self._lock:
+            if key in self._bundles:
+                return {"ok": True}
+            if not self._can_run(req):
+                return {"ok": False}
+            for r, q in req.items():
+                self._available[r] -= q
+            self._bundles[key] = dict(req)
+        return {"ok": True}
+
+    def _h_release_bundle(self, msg, frames):
+        key = (msg["pg_id"], msg["bundle_index"])
+        with self._lock:
+            req = self._bundles.pop(key, None)
+            if req:
+                for r, q in req.items():
+                    self._available[r] = min(self.resources.get(r, 0.0),
+                                             self._available[r] + q)
+        return {"ok": True}
+
+    def _h_node_info(self, msg, frames):
+        with self._lock:
+            return {"node_id": self.node_id, "address": self.address,
+                    "store_name": self.store.name, "resources": self.resources,
+                    "available": dict(self._available), "labels": self.labels,
+                    "num_workers": len(self._workers)}
+
+
+def _worker_died_error(name: str, code):
+    from ray_tpu.core import exceptions as exc
+
+    return exc.WorkerCrashedError(
+        f"worker executing {name!r} died unexpectedly (exit code {code})")
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--head-address", required=True)
+    ap.add_argument("--resources", required=True)  # json
+    ap.add_argument("--labels", default="{}")
+    ap.add_argument("--session-dir", default="/tmp/ray_tpu")
+    ap.add_argument("--address-file", default=None)
+    ap.add_argument("--store-capacity", type=int, default=None)
+    args = ap.parse_args()
+    import json
+
+    nl = Nodelet(args.head_address, json.loads(args.resources),
+                 labels=json.loads(args.labels), session_dir=args.session_dir,
+                 store_capacity=args.store_capacity).start()
+    if args.address_file:
+        tmp = args.address_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(nl.address)
+        os.replace(tmp, args.address_file)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    nl.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -17,13 +17,65 @@ Two implementations with one interface:
 from __future__ import annotations
 
 import ctypes
+import mmap
 import os
 import secrets
 import threading
-from multiprocessing import shared_memory
 
 DEFAULT_CAPACITY = int(os.environ.get("RAY_TPU_OBJECT_STORE_BYTES", 512 * 1024 * 1024))
 _TABLE_CAPACITY = 65536
+
+_SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else (
+    os.environ.get("TMPDIR", "/tmp"))
+
+
+class ShmSegment:
+    """Named shared-memory segment via raw shm file + mmap.
+
+    Deliberately NOT multiprocessing.shared_memory: its resource_tracker
+    unlinks 'leaked' segments when any attaching process dies without
+    cleanup — a crashing worker would destroy the node's object store
+    for everyone (exactly the crash-isolation plasma exists to provide).
+    """
+
+    def __init__(self, name: str | None = None, create: bool = False,
+                 size: int = 0):
+        if create:
+            name = name or f"rts_{secrets.token_hex(6)}"
+            path = os.path.join(_SHM_DIR, name)
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+            try:
+                os.ftruncate(fd, size)
+                self._mmap = mmap.mmap(fd, size)
+            finally:
+                os.close(fd)
+        else:
+            path = os.path.join(_SHM_DIR, name)
+            fd = os.open(path, os.O_RDWR)
+            try:
+                size = os.fstat(fd).st_size
+                self._mmap = mmap.mmap(fd, size)
+            finally:
+                os.close(fd)
+        self.name = name
+        self.size = size
+        self.buf = memoryview(self._mmap)
+
+    def close(self):
+        try:
+            self.buf.release()
+        except (BufferError, AttributeError):
+            pass
+        try:
+            self._mmap.close()
+        except (BufferError, ValueError):
+            pass  # exported pointers still alive; mapping dies with process
+
+    def unlink(self):
+        try:
+            os.unlink(os.path.join(_SHM_DIR, self.name))
+        except FileNotFoundError:
+            pass
 
 
 class ObjectStoreFullError(MemoryError):
@@ -75,14 +127,13 @@ class SharedMemoryStore:
         if self._lib is None:
             raise RuntimeError("native object store library unavailable")
         if create:
-            name = name or f"rts_{secrets.token_hex(6)}"
-            self._shm = shared_memory.SharedMemory(name=name, create=True, size=capacity)
-            self._base = ctypes.addressof(ctypes.c_char.from_buffer(self._shm.buf))
+            self._shm = ShmSegment(name=name, create=True, size=capacity)
+            self._base = ctypes.addressof(ctypes.c_char.from_buffer(self._shm._mmap))
             if self._lib.rts_init(self._base, self._shm.size, _TABLE_CAPACITY) != 0:
                 raise RuntimeError("object store segment too small")
         else:
-            self._shm = shared_memory.SharedMemory(name=name, create=False)
-            self._base = ctypes.addressof(ctypes.c_char.from_buffer(self._shm.buf))
+            self._shm = ShmSegment(name=name, create=False)
+            self._base = ctypes.addressof(ctypes.c_char.from_buffer(self._shm._mmap))
             if self._lib.rts_attached_ok(self._base) != 0:
                 raise RuntimeError(f"shm segment {name} is not an object store")
         self.name = self._shm.name
@@ -140,14 +191,8 @@ class SharedMemoryStore:
                 "evictions": e.value, "capacity": c.value}
 
     def close(self):
-        # drop ctypes' from_buffer export before closing the mmap
         self._base = None
-        import gc
-        gc.collect()
-        try:
-            self._shm.close()
-        except BufferError:
-            pass
+        self._shm.close()
 
     def unlink(self):
         if self._owner:
@@ -163,8 +208,8 @@ class SegmentPerObjectStore:
 
     def __init__(self, name: str | None = None, capacity: int = 0, create: bool = True):
         self.name = name or f"rts_{secrets.token_hex(6)}"
-        self._held: dict[bytes, shared_memory.SharedMemory] = {}
-        self._unsealed: dict[bytes, shared_memory.SharedMemory] = {}
+        self._held: dict[bytes, ShmSegment] = {}
+        self._unsealed: dict[bytes, ShmSegment] = {}
         self._owner = create
 
     def _seg_name(self, oid: bytes) -> str:
@@ -174,8 +219,8 @@ class SegmentPerObjectStore:
     _HDR = 16
 
     def create(self, oid: bytes, size: int) -> memoryview:
-        seg = shared_memory.SharedMemory(self._seg_name(oid), create=True,
-                                         size=max(1, size) + self._HDR)
+        seg = ShmSegment(self._seg_name(oid), create=True,
+                         size=max(1, size) + self._HDR)
         seg.buf[0] = 0  # unsealed
         seg.buf[8:16] = size.to_bytes(8, "little")
         self._unsealed[oid] = seg
@@ -200,7 +245,7 @@ class SegmentPerObjectStore:
         seg = self._held.get(oid)
         if seg is None:
             try:
-                seg = shared_memory.SharedMemory(self._seg_name(oid), create=False)
+                seg = ShmSegment(self._seg_name(oid), create=False)
             except FileNotFoundError:
                 return None
             self._held[oid] = seg

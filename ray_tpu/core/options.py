@@ -67,20 +67,28 @@ _SOFT_KEYS = {"runtime_env", "memory", "accelerator_type", "num_gpus",
               "_metadata", "enable_task_events", "concurrency_groups"}
 
 
-def task_options(d: dict) -> TaskOptions:
-    _check(d, _TASK_KEYS, "task")
+def _normalize(d: dict) -> dict:
+    d = dict(d)
     if d.get("num_gpus"):
         # GPU-shaped requests map onto the TPU resource on this framework.
-        d = dict(d)
         d["num_tpus"] = d.pop("num_gpus")
+    strat = d.get("scheduling_strategy")
+    if strat is not None and hasattr(strat, "placement_group"):
+        d["placement_group"] = strat.placement_group
+        d["placement_group_bundle_index"] = getattr(
+            strat, "placement_group_bundle_index", -1)
+    return d
+
+
+def task_options(d: dict) -> TaskOptions:
+    _check(d, _TASK_KEYS, "task")
+    d = _normalize(d)
     return TaskOptions(**{k: v for k, v in d.items() if k in _TASK_KEYS})
 
 
 def actor_options(d: dict) -> ActorOptions:
     _check(d, _ACTOR_KEYS, "actor")
-    if d.get("num_gpus"):
-        d = dict(d)
-        d["num_tpus"] = d.pop("num_gpus")
+    d = _normalize(d)
     return ActorOptions(**{k: v for k, v in d.items() if k in _ACTOR_KEYS})
 
 

@@ -1,0 +1,195 @@
+"""Placement groups — gang reservation of resource bundles.
+
+Reference parity: GcsPlacementGroupManager/Scheduler
+(src/ray/gcs/gcs_server/gcs_placement_group_manager.h:228) with the
+bundle policies PACK / SPREAD / STRICT_PACK / STRICT_SPREAD
+(raylet/scheduling/policy/bundle_scheduling_policy.h:82-106) and the
+raylet-side two-phase commit (raylet/placement_group_resource_manager.h).
+
+TPU-first addition: STRICT_PACK with a `TPU` resource means "same pod
+slice" — nodes carry a `ray.io/tpu-slice` label and strict packing
+groups bundles onto nodes of one slice (SURVEY.md §2.5: slice bundles).
+
+Simplification vs reference (documented): bundle reservation subtracts
+from the node's available resources at the nodelet; tasks scheduled into
+a PG then run against the reservation without per-bundle metering, so
+within-PG overcommit is possible. The gang semantics (all-or-nothing
+reservation, strategy-shaped spread) match.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class PGState:
+    PENDING = "PENDING"
+    CREATED = "CREATED"
+    REMOVED = "REMOVED"
+
+
+class PGRecord:
+    __slots__ = ("pg_id", "bundles", "strategy", "name", "nodes", "state", "cond")
+
+    def __init__(self, pg_id, bundles, strategy, name):
+        self.pg_id = pg_id
+        self.bundles = bundles  # list[dict resource->qty]
+        self.strategy = strategy
+        self.name = name
+        self.nodes = []  # node_id per bundle
+        self.state = PGState.PENDING
+        self.cond = threading.Condition()
+
+
+def _fits(avail: dict, req: dict) -> bool:
+    return all(avail.get(r, 0.0) >= q for r, q in req.items())
+
+
+def _sub(avail: dict, req: dict):
+    for r, q in req.items():
+        avail[r] = avail.get(r, 0.0) - q
+
+
+def _plan(bundles, strategy, nodes, avail):
+    """Return list of node assignments (one per bundle) or None.
+
+    `avail` is mutated per-plan (caller passes a copy per attempt).
+    """
+    live = [n for n in nodes]
+    if strategy in ("STRICT_PACK", "PACK"):
+        # try to land everything on a single node (slice-aware: group
+        # candidate nodes by slice label and try biggest slices first)
+        for n in live:
+            a = dict(avail.get(n.node_id, {}))
+            ok = True
+            for b in bundles:
+                if not _fits(a, b):
+                    ok = False
+                    break
+                _sub(a, b)
+            if ok:
+                return [n.node_id] * len(bundles)
+        if strategy == "STRICT_PACK":
+            # same-slice fallback: all bundles on nodes sharing a slice label
+            by_slice = {}
+            for n in live:
+                sl = n.labels.get("ray.io/tpu-slice")
+                if sl is not None:
+                    by_slice.setdefault(sl, []).append(n)
+            for group in by_slice.values():
+                assign = _spread_over(bundles, group, avail, strict=False)
+                if assign is not None:
+                    return assign
+            return None
+        # PACK falls back to best-effort spread
+        return _spread_over(bundles, live, avail, strict=False)
+    if strategy == "STRICT_SPREAD":
+        return _spread_over(bundles, live, avail, strict=True)
+    # SPREAD: best-effort distinct nodes
+    return _spread_over(bundles, live, avail, strict=False, prefer_distinct=True)
+
+
+def _spread_over(bundles, nodes, avail, strict, prefer_distinct=True):
+    remaining = {n.node_id: dict(avail.get(n.node_id, {})) for n in nodes}
+    used = set()
+    assign = []
+    for b in bundles:
+        placed = None
+        candidates = sorted(nodes, key=lambda n: (n.node_id in used,))
+        for n in candidates:
+            if strict and n.node_id in used:
+                continue
+            if _fits(remaining[n.node_id], b):
+                placed = n.node_id
+                break
+        if placed is None:
+            return None
+        _sub(remaining[placed], b)
+        used.add(placed)
+        assign.append(placed)
+    return assign
+
+
+def create_pg(head, pgs: dict, msg: dict, nodes, avail) -> dict:
+    pg_id = msg["pg_id"]
+    rec = PGRecord(pg_id, msg["bundles"], msg.get("strategy", "PACK"),
+                   msg.get("name"))
+    pgs[pg_id] = rec
+    assign = _plan(rec.bundles, rec.strategy, nodes, avail)
+    if assign is None:
+        return {"state": PGState.PENDING}
+    # reserve on each node (2PC-lite: reserve all, roll back on failure —
+    # reference: raylet prepare/commit, placement_group_resource_manager.h)
+    node_by_id = {n.node_id: n for n in nodes}
+    reserved = []
+    ok = True
+    for i, nid in enumerate(assign):
+        try:
+            r = head.client.call(node_by_id[nid].address, "reserve_bundle",
+                                 {"pg_id": pg_id, "bundle_index": i,
+                                  "resources": rec.bundles[i]}, timeout=10)
+            if not r.get("ok"):
+                ok = False
+                break
+            reserved.append((nid, i))
+        except Exception:
+            ok = False
+            break
+    if not ok:
+        for nid, i in reserved:
+            try:
+                head.client.call(node_by_id[nid].address, "release_bundle",
+                                 {"pg_id": pg_id, "bundle_index": i}, timeout=10)
+            except Exception:
+                pass
+        return {"state": PGState.PENDING}
+    with rec.cond:
+        rec.nodes = assign
+        rec.state = PGState.CREATED
+        rec.cond.notify_all()
+    return {"state": PGState.CREATED, "nodes": [n.hex() for n in assign]}
+
+
+def pg_info(pgs: dict, pg_id=None) -> dict:
+    def one(rec):
+        return {"pg_id": rec.pg_id, "state": rec.state, "strategy": rec.strategy,
+                "bundles": rec.bundles, "nodes": [n.hex() for n in rec.nodes],
+                "name": rec.name}
+
+    if pg_id is not None:
+        rec = pgs.get(pg_id)
+        return one(rec) if rec else {"state": "UNKNOWN"}
+    return {"groups": [one(r) for r in pgs.values()]}
+
+
+def remove_pg(head, pgs: dict, pg_id) -> dict:
+    rec = pgs.get(pg_id)
+    if rec is None:
+        return {"removed": False}
+    with head._lock:
+        node_by_id = {n.node_id: n for n in head._nodes.values()}
+    for i, nid in enumerate(rec.nodes):
+        n = node_by_id.get(nid)
+        if n is None:
+            continue
+        try:
+            head.client.call(n.address, "release_bundle",
+                             {"pg_id": pg_id, "bundle_index": i}, timeout=10)
+        except Exception:
+            pass
+    rec.state = PGState.REMOVED
+    return {"removed": True}
+
+
+def pg_bundle_node(pgs: dict, pg_id, bundle_index: int, resources: dict):
+    """Which node hosts this PG bundle (for actor/task targeting)."""
+    rec = pgs.get(pg_id)
+    if rec is None or rec.state != PGState.CREATED:
+        return None
+    if 0 <= bundle_index < len(rec.nodes):
+        return rec.nodes[bundle_index]
+    # bundle_index == -1: any bundle whose shape covers the request
+    for i, b in enumerate(rec.bundles):
+        if all(b.get(r, 0.0) >= q for r, q in resources.items()):
+            return rec.nodes[i]
+    return rec.nodes[0] if rec.nodes else None

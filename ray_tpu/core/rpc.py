@@ -1,0 +1,325 @@
+"""Control-plane RPC: request/reply + one-way messages over ZeroMQ.
+
+Reference parity: src/ray/rpc/ (GrpcServer, retryable clients). The
+reference generates gRPC services from .proto files; here the services
+are small enough that a single ROUTER socket per process with
+cloudpickle-encoded frames gives the same shape (typed handlers,
+correlation ids, retries) with far less machinery. Data-plane payloads
+(object chunks) ride the same channel as raw byte frames — no
+re-encoding copies.
+
+Wire format (multipart):
+  client → server: [msg_id(8B), method(utf8), payload, *raw_frames]
+  server → client: [msg_id(8B), status(1B), payload, *raw_frames]
+status: b"K" ok, b"E" error (payload = pickled exception).
+
+Fault injection (reference: rpc/rpc_chaos.h): set
+RAY_TPU_TESTING_RPC_FAILURE="method=N" and the client will drop the
+first N sends of `method`, exercising retry paths deterministically.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+
+import zmq
+
+from ray_tpu.core import serialization as ser
+
+_OK = b"K"
+_ERR = b"E"
+
+
+class RpcError(RuntimeError):
+    pass
+
+
+class PeerUnavailableError(RpcError):
+    pass
+
+
+# ---------------------------------------------------------------- chaos
+
+_chaos_lock = threading.Lock()
+_chaos_budget: dict[str, int] = {}
+
+
+def _chaos_init():
+    spec = os.environ.get("RAY_TPU_TESTING_RPC_FAILURE", "")
+    out = {}
+    for part in spec.split(","):
+        if "=" in part:
+            m, n = part.split("=", 1)
+            try:
+                out[m.strip()] = int(n)
+            except ValueError:
+                pass
+    return out
+
+
+_chaos_budget = _chaos_init()
+
+
+def _chaos_should_drop(method: str) -> bool:
+    if not _chaos_budget:
+        return False
+    with _chaos_lock:
+        n = _chaos_budget.get(method, 0)
+        if n > 0:
+            _chaos_budget[method] = n - 1
+            return True
+    return False
+
+
+# ---------------------------------------------------------------- server
+
+
+class RpcServer:
+    """One ROUTER socket; handlers run on a thread pool.
+
+    Handler signature: fn(msg: dict, frames: list[bytes]) -> result.
+    Result may be any picklable value, or a tuple (value, [raw_frames]).
+    Register one-way handlers with `oneway=True` — no reply is sent.
+    """
+
+    def __init__(self, name: str = "rpc", num_threads: int = 16):
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.ROUTER)
+        self._sock.setsockopt(zmq.LINGER, 0)
+        self._sock.setsockopt(zmq.ROUTER_MANDATORY, 0)
+        port = self._sock.bind_to_random_port("tcp://127.0.0.1")
+        self.address = f"127.0.0.1:{port}"
+        self._handlers: dict[str, tuple] = {}
+        self._pool = ThreadPoolExecutor(max_workers=num_threads,
+                                        thread_name_prefix=f"{name}-h")
+        self._send_lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"{name}-recv")
+
+    def register(self, method: str, fn, oneway: bool = False):
+        self._handlers[method] = (fn, oneway)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        poller = zmq.Poller()
+        poller.register(self._sock, zmq.POLLIN)
+        while not self._stopped.is_set():
+            if not dict(poller.poll(timeout=100)):
+                continue
+            try:
+                parts = self._sock.recv_multipart(zmq.NOBLOCK)
+            except zmq.Again:
+                continue
+            if len(parts) < 4:
+                continue
+            ident, msg_id, method_b, payload = parts[0], parts[1], parts[2], parts[3]
+            frames = [bytes(f) for f in parts[4:]]
+            self._pool.submit(self._dispatch, ident, msg_id, method_b.decode(),
+                              payload, frames)
+
+    def _dispatch(self, ident, msg_id, method, payload, frames):
+        entry = self._handlers.get(method)
+        if entry is None:
+            self._reply(ident, msg_id, _ERR,
+                        ser.dumps_msg(RpcError(f"no handler for {method!r}")))
+            return
+        fn, oneway = entry
+        try:
+            msg = ser.loads_msg(payload) if payload else {}
+            result = fn(msg, frames)
+            if oneway:
+                return
+            out_frames = []
+            if isinstance(result, tuple) and len(result) == 2 and \
+                    isinstance(result[1], list):
+                result, out_frames = result
+            self._reply(ident, msg_id, _OK, ser.dumps_msg(result), out_frames)
+        except Exception as e:  # noqa: BLE001
+            if not oneway:
+                try:
+                    blob = ser.dumps_msg(e)
+                except Exception:
+                    blob = ser.dumps_msg(RpcError(repr(e)))
+                self._reply(ident, msg_id, _ERR, blob)
+
+    def _reply(self, ident, msg_id, status, payload, frames=()):
+        with self._send_lock:
+            try:
+                self._sock.send_multipart([ident, msg_id, status, payload, *frames])
+            except zmq.ZMQError:
+                pass  # peer gone
+
+    def stop(self):
+        self._stopped.set()
+        self._thread.join(timeout=2)
+        self._pool.shutdown(wait=False)
+        try:
+            self._sock.close(0)
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------- client
+
+
+class _Peer:
+    def __init__(self, address: str):
+        self._ctx = zmq.Context.instance()
+        self.sock = self._ctx.socket(zmq.DEALER)
+        self.sock.setsockopt(zmq.LINGER, 0)
+        self.sock.connect(f"tcp://{address}")
+        self.address = address
+        self.send_lock = threading.Lock()
+        self.pending: dict[bytes, Future] = {}
+        self.pending_lock = threading.Lock()
+        self.recv_thread = threading.Thread(target=self._recv_loop, daemon=True,
+                                            name=f"rpc-cli-{address}")
+        self.stopped = threading.Event()
+        self.recv_thread.start()
+
+    def _recv_loop(self):
+        poller = zmq.Poller()
+        poller.register(self.sock, zmq.POLLIN)
+        while not self.stopped.is_set():
+            if not dict(poller.poll(timeout=100)):
+                continue
+            try:
+                parts = self.sock.recv_multipart(zmq.NOBLOCK)
+            except zmq.Again:
+                continue
+            except zmq.ZMQError:
+                return
+            if len(parts) < 3:
+                continue
+            msg_id, status, payload = parts[0], parts[1], parts[2]
+            frames = [bytes(f) for f in parts[3:]]
+            with self.pending_lock:
+                fut = self.pending.pop(bytes(msg_id), None)
+            if fut is None:
+                continue
+            if status == _OK:
+                fut.set_result((ser.loads_msg(payload) if payload else None, frames))
+            else:
+                try:
+                    fut.set_exception(ser.loads_msg(payload))
+                except Exception:
+                    fut.set_exception(RpcError("remote error (undecodable)"))
+
+    def close(self):
+        self.stopped.set()
+        with self.pending_lock:
+            for fut in self.pending.values():
+                if not fut.done():
+                    fut.set_exception(PeerUnavailableError(self.address))
+            self.pending.clear()
+        try:
+            self.sock.close(0)
+        except Exception:
+            pass
+
+
+class RpcClient:
+    """Shared per-process client; one DEALER per peer address."""
+
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._peers: dict[str, _Peer] = {}
+        self._lock = threading.Lock()
+        self._counter = 0
+
+    @classmethod
+    def shared(cls) -> "RpcClient":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset_shared(cls):
+        with cls._instance_lock:
+            if cls._instance is not None:
+                cls._instance.close()
+                cls._instance = None
+
+    def _peer(self, address: str) -> _Peer:
+        with self._lock:
+            p = self._peers.get(address)
+            if p is None:
+                p = self._peers[address] = _Peer(address)
+            return p
+
+    def _next_id(self) -> bytes:
+        with self._lock:
+            self._counter += 1
+            return struct.pack("<Q", self._counter)
+
+    def call_async(self, address: str, method: str, msg: dict | None = None,
+                   frames: list = ()) -> Future:
+        peer = self._peer(address)
+        msg_id = self._next_id()
+        fut: Future = Future()
+        with peer.pending_lock:
+            peer.pending[msg_id] = fut
+        if _chaos_should_drop(method):
+            return fut  # simulated network drop: caller's timeout/retry fires
+        payload = ser.dumps_msg(msg or {})
+        with peer.send_lock:
+            peer.sock.send_multipart([msg_id, method.encode(), payload, *frames])
+        return fut
+
+    def call(self, address: str, method: str, msg: dict | None = None,
+             frames: list = (), timeout: float = 30.0, retries: int = 0):
+        """Blocking call; returns the handler's value (frames discarded
+        unless you use call_frames)."""
+        value, _ = self.call_frames(address, method, msg, frames, timeout, retries)
+        return value
+
+    def call_frames(self, address: str, method: str, msg: dict | None = None,
+                    frames: list = (), timeout: float = 30.0, retries: int = 0):
+        last_exc = None
+        for attempt in range(retries + 1):
+            fut = self.call_async(address, method, msg, frames)
+            try:
+                return fut.result(timeout=timeout)
+            except TimeoutError as e:
+                last_exc = PeerUnavailableError(
+                    f"{method} to {address} timed out after {timeout}s")
+                last_exc.__cause__ = e
+            except PeerUnavailableError as e:
+                last_exc = e
+            if attempt < retries:
+                time.sleep(min(0.1 * (2 ** attempt), 1.0))
+        raise last_exc
+
+    def send_oneway(self, address: str, method: str, msg: dict | None = None,
+                    frames: list = ()):
+        peer = self._peer(address)
+        if _chaos_should_drop(method):
+            return
+        payload = ser.dumps_msg(msg or {})
+        with peer.send_lock:
+            peer.sock.send_multipart([b"\x00" * 8, method.encode(), payload, *frames])
+
+    def drop_peer(self, address: str):
+        with self._lock:
+            p = self._peers.pop(address, None)
+        if p is not None:
+            p.close()
+
+    def close(self):
+        with self._lock:
+            peers = list(self._peers.values())
+            self._peers.clear()
+        for p in peers:
+            p.close()

@@ -243,21 +243,22 @@ class LocalRuntime:
 
     def create_actor(self, cls, args, kwargs, opts: ActorOptions) -> ActorHandle:
         with self._actors_lock:
+            # check + register must be atomic, or concurrent
+            # get_if_exists creators race into duplicate actors
             if opts.name:
                 key = (opts.namespace or self.namespace, opts.name)
                 if key in self._named:
                     if opts.get_if_exists:
                         return self._handle(self._actors[self._named[key]])
                     raise ValueError(f"actor name {opts.name!r} already taken")
-        actor = _LocalActor(
-            actor_id=ActorID.random(),
-            cls=cls,
-            args=args,
-            kwargs=kwargs,
-            opts=opts,
-            restarts_left=opts.max_restarts,
-        )
-        with self._actors_lock:
+            actor = _LocalActor(
+                actor_id=ActorID.random(),
+                cls=cls,
+                args=args,
+                kwargs=kwargs,
+                opts=opts,
+                restarts_left=opts.max_restarts,
+            )
             self._actors[actor.actor_id] = actor
             if opts.name:
                 self._named[(opts.namespace or self.namespace, opts.name)] = actor.actor_id

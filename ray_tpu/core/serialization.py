@@ -61,7 +61,17 @@ def dumps(obj: Any) -> bytes:
     return bytes(out)
 
 
+def deserialize_info(buf: memoryview) -> tuple[Any, int]:
+    """Like deserialize, also returning the number of out-of-band
+    buffers the object graph references (0 ⇒ nothing aliases `buf`)."""
+    return _deserialize(buf)
+
+
 def deserialize(buf: memoryview) -> Any:
+    return _deserialize(buf)[0]
+
+
+def _deserialize(buf: memoryview) -> tuple[Any, int]:
     buf = buf.cast("B") if isinstance(buf, memoryview) else memoryview(buf)
     magic, nbuf = struct.unpack_from("<II", buf, 0)
     if magic != _MAGIC:
@@ -80,7 +90,7 @@ def deserialize(buf: memoryview) -> Any:
     for bl in blens:
         oob.append(buf[off:off + bl])
         off = _pad(off + bl)
-    return pickle.loads(pickle_bytes, buffers=oob)
+    return pickle.loads(pickle_bytes, buffers=oob), len(oob)
 
 
 def loads(data: bytes | memoryview) -> Any:

@@ -1,0 +1,72 @@
+"""Task / actor specs that travel over RPC.
+
+Reference parity: TaskSpecification (src/ray/common/task/task_spec.h) —
+here plain picklable dataclasses; the control messages are small and the
+bulk (args/results) travels as out-of-band frames or through the shm
+object store.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+# Values at or under this size ride inline in RPC messages; larger ones
+# go through the shared-memory store (reference: inline small returns to
+# the owner's in-process memory store, core_worker.cc ExecuteTask).
+INLINE_THRESHOLD = 64 * 1024
+
+
+@dataclasses.dataclass
+class RefArg:
+    """An ObjectRef argument: resolved by the executing worker against
+    the ref's owner (ownership model, reference_count.h)."""
+
+    oid: bytes
+    owner: str  # rpc address of the owning process
+
+
+@dataclasses.dataclass
+class TaskSpec:
+    task_id: bytes
+    name: str
+    fn_id: str  # key of the pickled function in the head KV
+    args: tuple  # values inline; RefArg markers for ObjectRefs
+    kwargs: dict
+    return_oids: list[bytes]
+    owner: str  # rpc address of the submitting process
+    resources: dict[str, float]
+    max_retries: int = 3
+    retry_exceptions: Any = False
+    spillback_count: int = 0
+    placement_group: bytes | None = None
+    bundle_index: int = -1
+    label_selector: dict | None = None
+
+
+@dataclasses.dataclass
+class ActorSpec:
+    actor_id: bytes
+    cls_blob: bytes  # cloudpickled class
+    args: tuple
+    kwargs: dict
+    name: str | None
+    namespace: str
+    owner: str
+    resources: dict[str, float]
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    lifetime: str | None = None
+    placement_group: bytes | None = None
+    bundle_index: int = -1
+    label_selector: dict | None = None
+
+
+@dataclasses.dataclass
+class NodeInfo:
+    node_id: bytes
+    address: str  # nodelet rpc address
+    resources: dict[str, float]
+    labels: dict[str, str]
+    store_name: str
+    alive: bool = True

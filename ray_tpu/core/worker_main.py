@@ -1,0 +1,236 @@
+"""Worker process — task execution loop + actor hosting.
+
+Reference parity: default_worker.py + CoreWorker::RunTaskExecutionLoop
+(src/ray/core_worker/core_worker.h:216) and the task receiver /
+actor scheduling queues (core_worker/transport/task_receiver.h,
+actor_scheduling_queue.h). The nodelet spawns this with env-var wiring;
+tasks arrive as direct RPC pushes (execute_task for leased normal tasks,
+actor_call straight from callers); results go DIRECTLY to the owner.
+"""
+
+from __future__ import annotations
+
+import os
+import queue as _queue
+import sys
+import threading
+import time
+import traceback
+
+import cloudpickle
+
+from ray_tpu.core import exceptions as exc
+from ray_tpu.core import serialization as ser
+from ray_tpu.core.api import ObjectRef, _set_runtime
+from ray_tpu.core.cluster_runtime import ClusterRuntime
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID
+from ray_tpu.core.object_store import open_store
+from ray_tpu.core.specs import INLINE_THRESHOLD, ActorSpec, RefArg, TaskSpec
+
+
+class WorkerRuntime(ClusterRuntime):
+    """ClusterRuntime + execution-side handlers."""
+
+    def __init__(self):
+        head = os.environ["RAY_TPU_HEAD_ADDR"]
+        nodelet = os.environ["RAY_TPU_NODELET_ADDR"]
+        super().__init__(mode="worker", head=head, nodelet=nodelet)
+        self.node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
+        self.worker_id_bytes = bytes.fromhex(os.environ["RAY_TPU_WORKER_ID"])
+        self.store = open_store(name=os.environ["RAY_TPU_STORE_NAME"],
+                                create=False)
+        self._actor_instance = None
+        self._actor_spec: ActorSpec | None = None
+        self._actor_inbox: _queue.Queue = _queue.Queue()
+        self.server.register("execute_task", self._h_execute_task, oneway=True)
+        self.server.register("become_actor", self._h_become_actor, oneway=True)
+        self.server.register("actor_call", self._h_actor_call)
+        self.server.register("exit_worker", self._h_exit, oneway=True)
+
+    # ------------------------------------------------------------ args
+
+    def _decode_args(self, args, kwargs):
+        def dec(v):
+            if isinstance(v, RefArg):
+                ref = ObjectRef(ObjectID(v.oid), owner=v.owner)
+                return self._get_one(ref, None)
+            return v
+
+        return tuple(dec(a) for a in args), {k: dec(v) for k, v in kwargs.items()}
+
+    # ------------------------------------------------------------ results
+
+    def _ship_results(self, owner: str, task_id: bytes, oids: list[bytes],
+                      values: list):
+        frames = []
+        locations = []
+        for b, v in zip(oids, values):
+            head_payload, views, total = ser.serialize(v)
+            if total <= INLINE_THRESHOLD:
+                buf = bytearray(total)
+                ser.write_into(memoryview(buf), head_payload, views)
+                frames.append(bytes(buf))
+                locations.append(None)
+            else:
+                try:
+                    mv = self.store.create(b, total)
+                    ser.write_into(mv, head_payload, views)
+                    del mv
+                    self.store.seal(b)
+                    frames.append(b"")
+                    locations.append({"address": self.nodelet_address,
+                                      "store_name": self.store.name})
+                except KeyError:
+                    frames.append(b"")
+                    locations.append({"address": self.nodelet_address,
+                                      "store_name": self.store.name})
+                except Exception:
+                    buf = bytearray(total)
+                    ser.write_into(memoryview(buf), head_payload, views)
+                    frames.append(bytes(buf))
+                    locations.append(None)
+        self.client.send_oneway(owner, "task_done", {
+            "task_id": task_id, "oids": oids, "locations": locations,
+        }, frames=frames)
+
+    def _ship_error(self, owner: str, task_id: bytes, oids: list[bytes],
+                    error: BaseException, retryable=False):
+        try:
+            blob = ser.dumps_msg(error)
+        except Exception:
+            blob = ser.dumps_msg(exc.TaskError(RuntimeError(repr(error))))
+        try:
+            self.client.send_oneway(owner, "task_done", {
+                "task_id": task_id, "oids": oids, "error": blob,
+                "retryable": retryable,
+            })
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------ normal tasks
+
+    def _h_execute_task(self, msg, frames):
+        spec = TaskSpec(**msg["spec"])
+        self._ctx.task_id = TaskID(spec.task_id)
+        try:
+            fn = self._fetch_fn(spec.fn_id)
+            a, kw = self._decode_args(spec.args, spec.kwargs)
+            with self._events.span(spec.name, "task"):
+                result = fn(*a, **kw)
+            n = len(spec.return_oids)
+            if n == 0:
+                values = []
+            elif n == 1:
+                values = [result]
+            else:
+                values = list(result)
+                if len(values) != n:
+                    raise ValueError(
+                        f"task {spec.name} returned {len(values)} values, "
+                        f"expected {n}")
+            self._ship_results(spec.owner, spec.task_id, spec.return_oids, values)
+        except Exception as e:  # noqa: BLE001
+            err = exc.TaskError.from_exception(e, spec.name)
+            retryable = _matches_retry(e, spec.retry_exceptions)
+            self._ship_error(spec.owner, spec.task_id, spec.return_oids, err,
+                             retryable)
+        finally:
+            self._ctx.task_id = None
+            try:
+                self.client.send_oneway(self.nodelet_address, "task_finished",
+                                        {"worker_id": self.worker_id_bytes})
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------ actors
+
+    def _h_become_actor(self, msg, frames):
+        spec = ActorSpec(**msg["spec"])
+        spec.cls_blob = frames[0]
+        self._actor_spec = spec
+        self._ctx.actor_id = ActorID(spec.actor_id)
+        try:
+            cls = cloudpickle.loads(spec.cls_blob)
+            a, kw = self._decode_args(spec.args, spec.kwargs)
+            self._actor_instance = cls(*a, **kw)
+        except Exception as e:  # noqa: BLE001
+            cause = f"__init__ failed: {e}\n{traceback.format_exc()}"
+            try:
+                self.client.call(self.head_address, "actor_died",
+                                 {"actor_id": spec.actor_id, "cause": cause,
+                                  "no_restart": True}, timeout=10)
+            except Exception:
+                pass
+            os._exit(1)
+        for _ in range(max(1, spec.max_concurrency)):
+            threading.Thread(target=self._actor_exec_loop, daemon=True).start()
+        self.client.send_oneway(self.head_address, "actor_ready",
+                                {"actor_id": spec.actor_id,
+                                 "address": self.address})
+
+    def _h_actor_call(self, msg, frames):
+        if self._actor_spec is None:
+            raise exc.ActorUnavailableError("not an actor worker")
+        self._actor_inbox.put(msg)
+        return {"queued": True}
+
+    def _actor_exec_loop(self):
+        while True:
+            msg = self._actor_inbox.get()
+            if msg is None:
+                return
+            owner = msg["owner"]
+            oids = msg["oids"]
+            mname = msg["method"]
+            task_id = msg.get("task_id", b"")
+            try:
+                a, kw = self._decode_args(msg["args"], msg["kwargs"])
+                fn = getattr(self._actor_instance, mname)
+                with self._events.span(
+                        f"{type(self._actor_instance).__name__}.{mname}",
+                        "actor_task"):
+                    result = fn(*a, **kw)
+                n = len(oids)
+                values = [result] if n == 1 else (list(result) if n else [])
+                self._ship_results(owner, task_id, oids, values)
+            except Exception as e:  # noqa: BLE001
+                err = exc.TaskError.from_exception(
+                    e, f"{type(self._actor_instance).__name__}.{mname}")
+                self._ship_error(owner, task_id, oids, err)
+
+    def _h_exit(self, msg, frames):
+        os._exit(0)
+
+
+def _matches_retry(e, retry_exceptions) -> bool:
+    if retry_exceptions is True:
+        return True
+    if isinstance(retry_exceptions, (list, tuple)):
+        return isinstance(e, tuple(retry_exceptions))
+    return False
+
+
+def main():
+    t0 = time.monotonic()
+    rt = WorkerRuntime()
+    _set_runtime(rt)
+    nodelet = rt.nodelet_address
+    rt.client.call(nodelet, "worker_ready",
+                   {"worker_id": rt.worker_id_bytes, "address": rt.address},
+                   timeout=30, retries=3)
+    print(f"[worker] ready in {time.monotonic() - t0:.3f}s", flush=True)
+    # Stay alive while the nodelet is reachable; exit if orphaned.
+    misses = 0
+    while True:
+        time.sleep(2.0)
+        try:
+            rt.client.call(nodelet, "ping", {}, timeout=5)
+            misses = 0
+        except Exception:
+            misses += 1
+            if misses >= 3:
+                os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

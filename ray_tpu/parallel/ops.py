@@ -1,0 +1,62 @@
+"""In-program (SPMD) collectives over mesh axes.
+
+This is the data-plane replacement for the reference's NCCL groups
+(util/collective/collective_group/nccl_collective_group.py) and the
+compiled-DAG channel collectives (experimental/channel/nccl_group.py):
+inside a pjit/shard_map program, XLA lowers these to ICI collectives on
+TPU — no process-level machinery at all. Use the host-side
+ray_tpu.util.collective only for out-of-band CPU metadata.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.parallel.mesh import AXIS_DATA
+
+
+def psum(x, axis_name: str | tuple = AXIS_DATA):
+    return jax.lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name: str | tuple = AXIS_DATA):
+    return jax.lax.pmean(x, axis_name)
+
+def pmax(x, axis_name: str | tuple = AXIS_DATA):
+    return jax.lax.pmax(x, axis_name)
+
+
+def all_gather(x, axis_name: str, *, axis: int = 0, tiled: bool = True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str, *, scatter_dimension: int = 0):
+    return jax.lax.psum_scatter(x, axis_name,
+                                scatter_dimension=scatter_dimension,
+                                tiled=True)
+
+
+def all_to_all(x, axis_name: str, *, split_axis: int, concat_axis: int):
+    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+
+
+def ppermute(x, axis_name: str, perm):
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def ring_shift(x, axis_name: str, shift: int = 1):
+    """Shift values around the axis ring (building block of ring
+    attention / pipelined collectives)."""
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name: str):
+    return jax.lax.axis_index(axis_name)
+
+
+def axis_size(axis_name: str):
+    return jax.lax.axis_size(axis_name)

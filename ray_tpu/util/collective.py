@@ -1,0 +1,256 @@
+"""Out-of-band collectives between named groups of tasks/actors.
+
+Reference parity: python/ray/util/collective/collective.py
+(GroupManager :40, init_collective_group :120, allreduce :258,
+allgather :423, reducescatter :472, send/recv :531,594, broadcast,
+barrier) with NCCL/Gloo backends.
+
+TPU-first split (SURVEY.md §2.5): tensors that live on device inside an
+SPMD program use in-program XLA collectives (ray_tpu.parallel.ops —
+psum/all_gather/ppermute over mesh axes; zero extra machinery, rides
+ICI). THIS module is the host-side path the reference's Gloo backend
+covers: numpy arrays held by N separate actor/task processes. It runs
+over a rendezvous actor (per group) through the object store — correct
+everywhere, used for metadata barriers, weight broadcast, and CPU
+reductions, not for the training hot loop (which is in-program).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+    MEAN = "mean"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda arrs: _tree_binop(arrs, np.add),
+    ReduceOp.PRODUCT: lambda arrs: _tree_binop(arrs, np.multiply),
+    ReduceOp.MIN: lambda arrs: _tree_binop(arrs, np.minimum),
+    ReduceOp.MAX: lambda arrs: _tree_binop(arrs, np.maximum),
+    ReduceOp.MEAN: lambda arrs: _tree_scale(_tree_binop(arrs, np.add),
+                                            1.0 / len(arrs)),
+}
+
+
+def _tree_binop(arrs, op):
+    out = arrs[0]
+    for a in arrs[1:]:
+        out = _map2(out, a, op)
+    return out
+
+
+def _map2(a, b, op):
+    if isinstance(a, dict):
+        return {k: _map2(a[k], b[k], op) for k in a}
+    if isinstance(a, (list, tuple)):
+        t = [_map2(x, y, op) for x, y in zip(a, b)]
+        return type(a)(t) if not isinstance(a, tuple) else tuple(t)
+    return op(a, b)
+
+
+def _tree_scale(a, s):
+    if isinstance(a, dict):
+        return {k: _tree_scale(v, s) for k, v in a.items()}
+    if isinstance(a, (list, tuple)):
+        t = [_tree_scale(x, s) for x in a]
+        return tuple(t) if isinstance(a, tuple) else t
+    return a * s
+
+
+class _Rendezvous:
+    """Coordinator actor for one collective group. All ops are keyed by a
+    per-member monotonically increasing sequence number, so members may
+    pipeline ops without cross-talk."""
+
+    def __init__(self, world_size: int):
+        self.world = world_size
+        self._lock = threading.Lock()
+        self._rounds: dict[tuple, dict] = {}  # (kind, seq) -> state
+        self._mail: dict[tuple, Any] = {}  # (src, dst, seq) -> payload
+
+    def _round(self, key):
+        with self._lock:
+            r = self._rounds.get(key)
+            if r is None:
+                r = self._rounds[key] = {"data": {}, "event": threading.Event(),
+                                         "result": None, "done": 0}
+            return r
+
+    def _finish(self, key, r):
+        # last reader cleans up
+        with self._lock:
+            r["done"] += 1
+            if r["done"] >= self.world:
+                self._rounds.pop(key, None)
+
+    def contribute(self, kind: str, seq: int, rank: int, data, op: str | None,
+                   root: int | None = None):
+        key = (kind, seq)
+        r = self._round(key)
+        with self._lock:
+            r["data"][rank] = data
+            complete = len(r["data"]) == self.world
+            if complete and r["result"] is None:
+                ordered = [r["data"][i] for i in range(self.world)]
+                if kind == "allreduce":
+                    r["result"] = _REDUCERS[op](ordered)
+                elif kind == "allgather":
+                    r["result"] = ordered
+                elif kind == "broadcast":
+                    r["result"] = r["data"][root]
+                elif kind == "barrier":
+                    r["result"] = True
+                elif kind == "reducescatter":
+                    reduced = _REDUCERS[op](ordered)
+                    r["result"] = reduced
+                r["event"].set()
+        if not r["event"].wait(timeout=120):
+            raise TimeoutError(f"collective {kind}#{seq} timed out "
+                               f"({len(r['data'])}/{self.world} arrived)")
+        result = r["result"]
+        self._finish(key, r)
+        return result
+
+    def send(self, src: int, dst: int, seq: int, payload):
+        with self._lock:
+            self._mail[(src, dst, seq)] = payload
+        return True
+
+    def recv(self, src: int, dst: int, seq: int, timeout: float = 120):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if (src, dst, seq) in self._mail:
+                    return self._mail.pop((src, dst, seq))
+            time.sleep(0.002)
+        raise TimeoutError(f"recv from {src} (seq {seq}) timed out")
+
+
+class _GroupState:
+    def __init__(self, name, world_size, rank, coordinator):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.coordinator = coordinator
+        self.seq = 0
+        self.pt_seq = {}
+
+    def next_seq(self) -> int:
+        s = self.seq
+        self.seq += 1
+        return s
+
+
+_groups: dict[str, _GroupState] = {}
+_groups_lock = threading.Lock()
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "auto",
+                          group_name: str = "default"):
+    """Join (and lazily create) the named group. Every member must call
+    this before using collectives (reference: collective.py:120)."""
+    import ray_tpu
+
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} outside [0, {world_size})")
+    coord_cls = ray_tpu.remote(num_cpus=0)(_Rendezvous)
+    coord = coord_cls.options(
+        name=f"__collective_{group_name}", get_if_exists=True,
+        max_concurrency=max(4, 2 * world_size)).remote(world_size)
+    with _groups_lock:
+        _groups[group_name] = _GroupState(group_name, world_size, rank, coord)
+    barrier(group_name)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _groups
+
+
+def destroy_collective_group(group_name: str = "default"):
+    with _groups_lock:
+        _groups.pop(group_name, None)
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _get(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _get(group_name).world_size
+
+
+def _get(group_name) -> _GroupState:
+    g = _groups.get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized in this process")
+    return g
+
+
+def _sync(g: _GroupState, kind, data, op=None, root=None):
+    import ray_tpu
+
+    seq = g.next_seq()
+    return ray_tpu.get(
+        g.coordinator.contribute.remote(kind, seq, g.rank, data, op, root),
+        timeout=180)
+
+
+def allreduce(tensor, group_name: str = "default", op: str = ReduceOp.SUM):
+    return _sync(_get(group_name), "allreduce", tensor, op=op)
+
+
+def allreduce_multigpu(tensor_list, group_name="default", op=ReduceOp.SUM):
+    return [allreduce(t, group_name, op) for t in tensor_list]
+
+
+def allgather(tensor, group_name: str = "default") -> list:
+    return _sync(_get(group_name), "allgather", tensor)
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = ReduceOp.SUM):
+    """Each rank gets its 1/world shard (along axis 0) of the reduction."""
+    g = _get(group_name)
+    reduced = _sync(g, "reducescatter", tensor, op=op)
+    return np.array_split(reduced, g.world_size, axis=0)[g.rank]
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    g = _get(group_name)
+    return _sync(g, "broadcast", tensor if g.rank == src_rank else None,
+                 root=src_rank)
+
+
+def barrier(group_name: str = "default"):
+    _sync(_get(group_name), "barrier", None)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    import ray_tpu
+
+    g = _get(group_name)
+    key = (g.rank, dst_rank)
+    seq = g.pt_seq.get(key, 0)
+    g.pt_seq[key] = seq + 1
+    ray_tpu.get(g.coordinator.send.remote(g.rank, dst_rank, seq, tensor))
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    import ray_tpu
+
+    g = _get(group_name)
+    key = (src_rank, g.rank)
+    seq = g.pt_seq.get(key, 0)
+    g.pt_seq[key] = seq + 1
+    return ray_tpu.get(g.coordinator.recv.remote(src_rank, g.rank, seq))

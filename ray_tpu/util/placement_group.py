@@ -1,0 +1,91 @@
+"""Placement group public API.
+
+Reference parity: python/ray/util/placement_group.py:41,145
+(placement_group(), PlacementGroup.ready()/wait(), remove_placement_group)
+and scheduling strategies (python/ray/util/scheduling_strategies.py:15).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ray_tpu.core.api import _global_runtime
+from ray_tpu.core.exceptions import PlacementGroupError
+from ray_tpu.core.ids import PlacementGroupID
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: list[dict]):
+        self.id = pg_id
+        self.bundle_specs = bundles
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def _state(self) -> dict:
+        rt = _global_runtime()
+        return rt.client.call(rt.head_address, "pg_table",
+                              {"pg_id": self.id.binary()}, timeout=10)
+
+    def wait(self, timeout_seconds: float = 30) -> bool:
+        deadline = time.monotonic() + timeout_seconds
+        while time.monotonic() < deadline:
+            if self._state().get("state") == "CREATED":
+                return True
+            time.sleep(0.05)
+        return self._state().get("state") == "CREATED"
+
+    def ready(self):
+        """ObjectRef-like blocking readiness (reference returns an
+        ObjectRef; here a ref produced by a trivial task inside the PG
+        would deadlock a 0-CPU test cluster, so wait() semantics)."""
+        if not self.wait(timeout_seconds=60):
+            raise PlacementGroupError(
+                f"placement group {self.id.hex()[:12]} not ready")
+        return self
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundle_specs))
+
+
+def placement_group(bundles: list[dict], strategy: str = "PACK",
+                    name: str | None = None, lifetime: str | None = None) -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
+    if not bundles or not all(isinstance(b, dict) and b for b in bundles):
+        raise ValueError("bundles must be a non-empty list of non-empty dicts")
+    rt = _global_runtime()
+    pg_id = PlacementGroupID.random()
+    rt.client.call(rt.head_address, "create_pg", {
+        "pg_id": pg_id.binary(),
+        "bundles": [dict(b) for b in bundles],
+        "strategy": strategy,
+        "name": name,
+    }, timeout=30)
+    return PlacementGroup(pg_id, [dict(b) for b in bundles])
+
+
+def remove_placement_group(pg: PlacementGroup):
+    rt = _global_runtime()
+    rt.client.call(rt.head_address, "remove_pg", {"pg_id": pg.id.binary()},
+                   timeout=30)
+
+
+def placement_group_table(pg: PlacementGroup | None = None) -> dict:
+    rt = _global_runtime()
+    return rt.client.call(rt.head_address, "pg_table",
+                          {"pg_id": pg.id.binary() if pg else None}, timeout=10)
+
+
+class PlacementGroupSchedulingStrategy:
+    """Reference: python/ray/util/scheduling_strategies.py:15."""
+
+    def __init__(self, placement_group: PlacementGroup,
+                 placement_group_bundle_index: int = -1,
+                 placement_group_capture_child_tasks: bool = False):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.placement_group_capture_child_tasks = placement_group_capture_child_tasks

@@ -1,0 +1,149 @@
+"""Collective API tests (reference model:
+python/ray/util/collective/tests/ — groups of actors reducing numpy
+arrays; plus in-program XLA collectives on the virtual mesh)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import collective as col
+
+
+@pytest.fixture
+def ray_local():
+    ray_tpu.init(local_mode=True, num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+    col._groups.clear()
+
+
+@ray_tpu.remote
+class Member:
+    def __init__(self, rank, world):
+        self.rank = rank
+        self.world = world
+
+    def setup(self, group):
+        col.init_collective_group(self.world, self.rank, group_name=group)
+        return True
+
+    def do_allreduce(self, group):
+        x = np.full((4,), float(self.rank + 1))
+        return col.allreduce(x, group_name=group)
+
+    def do_allgather(self, group):
+        return col.allgather(np.array([self.rank]), group_name=group)
+
+    def do_broadcast(self, group):
+        x = np.arange(3.0) if self.rank == 0 else None
+        return col.broadcast(x, src_rank=0, group_name=group)
+
+    def do_reducescatter(self, group):
+        x = np.arange(8.0)
+        return col.reducescatter(x, group_name=group)
+
+    def do_sendrecv(self, group):
+        if self.rank == 0:
+            col.send(np.array([42.0]), dst_rank=1, group_name=group)
+            return None
+        return col.recv(src_rank=0, group_name=group)
+
+
+def _mk_group(n, group):
+    members = [Member.remote(i, n) for i in range(n)]
+    ray_tpu.get([m.setup.remote(group) for m in members])
+    return members
+
+
+def test_allreduce_sum(ray_local):
+    ms = _mk_group(4, "g1")
+    outs = ray_tpu.get([m.do_allreduce.remote("g1") for m in ms])
+    for o in outs:
+        assert np.array_equal(o, np.full((4,), 1.0 + 2 + 3 + 4))
+
+
+def test_allgather(ray_local):
+    ms = _mk_group(3, "g2")
+    outs = ray_tpu.get([m.do_allgather.remote("g2") for m in ms])
+    for o in outs:
+        assert [int(x[0]) for x in o] == [0, 1, 2]
+
+
+def test_broadcast(ray_local):
+    ms = _mk_group(3, "g3")
+    outs = ray_tpu.get([m.do_broadcast.remote("g3") for m in ms])
+    for o in outs:
+        assert np.array_equal(o, np.arange(3.0))
+
+
+def test_reducescatter(ray_local):
+    ms = _mk_group(2, "g4")
+    outs = ray_tpu.get([m.do_reducescatter.remote("g4") for m in ms])
+    # sum over 2 ranks of arange(8) = 2*arange(8); rank i gets half i
+    assert np.array_equal(outs[0], 2 * np.arange(4.0))
+    assert np.array_equal(outs[1], 2 * np.arange(4.0, 8.0))
+
+
+def test_send_recv(ray_local):
+    ms = _mk_group(2, "g5")
+    outs = ray_tpu.get([m.do_sendrecv.remote("g5") for m in ms])
+    assert outs[0] is None
+    assert np.array_equal(outs[1], np.array([42.0]))
+
+
+def test_allreduce_pytree(ray_local):
+    ms = _mk_group(2, "g6")
+
+    @ray_tpu.remote
+    def member_tree(rank):
+        col.init_collective_group(2, rank, group_name="g6t")
+        tree = {"w": np.ones((2, 2)) * (rank + 1), "b": np.array([rank])}
+        return col.allreduce(tree, group_name="g6t")
+
+    outs = ray_tpu.get([member_tree.remote(i) for i in range(2)])
+    for o in outs:
+        assert np.array_equal(o["w"], np.full((2, 2), 3.0))
+        assert np.array_equal(o["b"], np.array([1]))
+
+
+# ---------------------------------------------------------------- in-program
+
+
+def test_in_program_collectives_on_mesh(cpu_mesh8):
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel import ops
+
+    mesh = cpu_mesh8
+
+    def f(x):
+        s = ops.psum(x, ("data", "fsdp", "tensor"))
+        g = ops.all_gather(x, "tensor", axis=0)
+        return s, g
+
+    x = np.arange(8.0).reshape(8, 1)
+    fm = shard_map(f, mesh=mesh, in_specs=P(("data", "fsdp", "tensor")),
+                   out_specs=(P(), P(("data", "fsdp"))))
+    s, g = fm(x)
+    assert float(np.asarray(s)[0]) == x.sum()
+    assert np.asarray(g).shape == (8, 1)
+
+
+def test_ring_shift(cpu_mesh8):
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel import ops
+
+    mesh = cpu_mesh8
+
+    def f(x):
+        return ops.ring_shift(x, "data", 1)
+
+    x = np.arange(2.0).reshape(2, 1)
+    fm = shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    out = np.asarray(fm(x)).ravel()
+    assert out.tolist() == [1.0, 0.0]
