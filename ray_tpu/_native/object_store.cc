@@ -23,6 +23,11 @@
 #include <cstdint>
 #include <cstring>
 
+#include <errno.h>
+#include <signal.h>
+#include <time.h>
+#include <unistd.h>
+
 extern "C" {
 
 static const uint64_t kMagic = 0x52415954505553ULL;  // "RAYTPUS"
@@ -74,17 +79,37 @@ static inline FreeBlock* FB(void* base, uint64_t off) {
   return reinterpret_cast<FreeBlock*>(reinterpret_cast<char*>(base) + off);
 }
 
+// Crash-robust lock: the lock word holds the holder's pid. If the holder
+// dies while inside a critical section (workers are routinely SIGTERM'd
+// mid-operation), waiters detect the dead pid via kill(pid, 0) and steal
+// the lock instead of spinning forever (the hang the plasma store-server
+// design avoids by construction; here recovery is explicit).
 static void lock(Header* h) {
+  uint32_t me = (uint32_t)getpid();
   uint32_t expected = 0;
   int spins = 0;
-  while (!h->lock.compare_exchange_weak(expected, 1, std::memory_order_acquire)) {
+  while (!h->lock.compare_exchange_weak(expected, me, std::memory_order_acquire)) {
+    uint32_t holder = expected;
     expected = 0;
-    if (++spins > 4096) {
-#if defined(__x86_64__)
-      __builtin_ia32_pause();
-#endif
+    if (++spins > 2048) {
       spins = 0;
+      if (holder != 0 && holder != me &&
+          kill((pid_t)holder, 0) == -1 && errno == ESRCH) {
+        // holder is gone: steal (metadata may be mid-mutation, but the
+        // alternative is a node-wide hang; mutations are short and the
+        // allocator tolerates a torn free-list far better than a freeze)
+        uint32_t want = holder;
+        if (h->lock.compare_exchange_strong(want, me,
+                                            std::memory_order_acquire)) {
+          return;
+        }
+      }
+      struct timespec ts = {0, 50000};  // 50us
+      nanosleep(&ts, nullptr);
     }
+#if defined(__x86_64__)
+    __builtin_ia32_pause();
+#endif
   }
 }
 static void unlock(Header* h) { h->lock.store(0, std::memory_order_release); }

@@ -451,8 +451,11 @@ class ClusterRuntime:
                             s.retries_left -= 1
             if can_retry:
                 try:
+                    spec.attempt += 1
+                    spec.spillback_count = 0
                     self.client.call(self.nodelet_address, "schedule_task",
-                                     {"spec": dataclass_dict(spec)}, timeout=30)
+                                     {"spec": dataclass_dict(spec)}, timeout=30,
+                                     retries=2)
                     return True
                 except Exception:
                     pass
@@ -670,13 +673,21 @@ class ClusterRuntime:
             "oids": [o.binary() for o in oids],
             "owner": self.address,
         }
+        # At-most-once by default (reference: actor tasks are not retried
+        # unless max_task_retries>0, python/ray/actor.py): once a push may
+        # have been DELIVERED (it timed out rather than failing to send),
+        # re-sending could execute the method twice — or, for a call that
+        # killed the actor, kill every restart and burn the whole restart
+        # budget. Opt-in retries re-resolve the (possibly restarted) actor.
+        tries = 1 + int(mopts.get("max_task_retries", 0) or 0)
         last_err = None
-        for attempt in range(3):
+        for attempt in range(tries):
             try:
                 addr = self._resolve_actor(ab)
             except exc.RayTpuError as e:
                 self._error_oids([o.binary() for o in oids], e)
                 self._unpin_task_args(task_id)
+                last_err = None
                 break
             try:
                 self.client.call(addr, "actor_call", msg, timeout=30)
@@ -687,8 +698,6 @@ class ClusterRuntime:
                 with self._lock:
                     self._actor_addr.pop(ab, None)  # force re-resolve
                 time.sleep(0.2)
-        else:
-            pass
         if last_err is not None:
             self._error_oids(
                 [o.binary() for o in oids],

@@ -202,10 +202,14 @@ class Head:
         return {"actor_id": spec.actor_id, "existing": False}
 
     def _pick_node(self, resources: dict, pg: bytes | None = None,
-                   bundle_index: int = -1, label_selector: dict | None = None):
+                   bundle_index: int = -1, label_selector: dict | None = None,
+                   exclude: set | None = None, require_avail: bool = False):
         """Best-fit placement over the freshest resource view (reference:
         GcsActorScheduler / hybrid policy; simplified to best-fit since
-        nodelets do their own local queueing)."""
+        nodelets do their own local queueing). Picking a node decrements
+        the head's view of its availability immediately so concurrent
+        placements in one heartbeat window don't double-place (the next
+        heartbeat overwrites the view with ground truth)."""
         from ray_tpu.core.placement import pg_bundle_node
         with self._lock:
             if pg is not None:
@@ -215,7 +219,7 @@ class Head:
                 return None
             best, best_score = None, None
             for n in self._nodes.values():
-                if not n.alive:
+                if not n.alive or (exclude and n.node_id in exclude):
                     continue
                 if label_selector and any(n.labels.get(k) != v
                                           for k, v in label_selector.items()):
@@ -224,43 +228,62 @@ class Head:
                 total = n.resources
                 if any(total.get(r, 0.0) < q for r, q in resources.items()):
                     continue  # infeasible on this node
+                if require_avail and any(avail.get(r, 0.0) < q
+                                         for r, q in resources.items()):
+                    continue
                 free = sum(min(avail.get(r, 0.0) / q, 10.0)
                            for r, q in resources.items() if q) if resources else \
                     sum(avail.values())
                 if best_score is None or free > best_score:
                     best, best_score = n, free
+            if best is not None:
+                avail = self._available.get(best.node_id)
+                if avail is not None:
+                    for r, q in resources.items():
+                        avail[r] = avail.get(r, 0.0) - q
             return best
 
     def _schedule_actor(self, rec: _ActorRecord):
-        node = self._pick_node(rec.spec.resources, rec.spec.placement_group,
-                               rec.spec.bundle_index, rec.spec.label_selector)
-        if node is None:
-            # no feasible node right now: retry in the background
-            def retry():
-                deadline = time.monotonic() + 60
-                while time.monotonic() < deadline and not self._stopped.is_set():
-                    time.sleep(0.2)
-                    n = self._pick_node(rec.spec.resources, rec.spec.placement_group,
-                                        rec.spec.bundle_index, rec.spec.label_selector)
-                    if n is not None:
-                        self._send_start(rec, n)
+        """Place and start an actor, retrying other nodes on start
+        failure. A scheduling race (stale resource view, nodelet refusing
+        with 'insufficient resources') must NOT consume the actor's
+        restart budget — only post-ALIVE deaths do (reference:
+        GcsActorScheduler reschedules on lease rejection)."""
+
+        def run():
+            deadline = time.monotonic() + 60
+            failed: set = set()
+            while time.monotonic() < deadline and not self._stopped.is_set():
+                with rec.cond:
+                    if rec.state == ActorState.DEAD:
                         return
-                self._actor_died(rec, "no feasible node for actor resources "
-                                 f"{rec.spec.resources}", allow_restart=False)
+                node = self._pick_node(rec.spec.resources,
+                                       rec.spec.placement_group,
+                                       rec.spec.bundle_index,
+                                       rec.spec.label_selector,
+                                       exclude=failed, require_avail=True)
+                if node is None and failed:
+                    # every available node refused: widen to any feasible
+                    node = self._pick_node(rec.spec.resources,
+                                           rec.spec.placement_group,
+                                           rec.spec.bundle_index,
+                                           rec.spec.label_selector,
+                                           require_avail=True)
+                if node is not None:
+                    with self._lock:
+                        rec.node_id = node.node_id
+                    try:
+                        self.client.call(node.address, "start_actor",
+                                         {"spec": dataclass_dict(rec.spec)},
+                                         frames=[rec.spec.cls_blob], timeout=60)
+                        return  # started; actor_ready/actor_died drive the rest
+                    except Exception:  # noqa: BLE001
+                        failed.add(node.node_id)
+                time.sleep(0.2)
+            self._actor_died(rec, "no feasible node for actor resources "
+                             f"{rec.spec.resources}", allow_restart=False)
 
-            threading.Thread(target=retry, daemon=True).start()
-            return
-        self._send_start(rec, node)
-
-    def _send_start(self, rec: _ActorRecord, node: NodeInfo):
-        with self._lock:
-            rec.node_id = node.node_id
-        try:
-            self.client.call(node.address, "start_actor",
-                             {"spec": dataclass_dict(rec.spec)},
-                             frames=[rec.spec.cls_blob], timeout=60)
-        except Exception as e:  # noqa: BLE001
-            self._actor_died(rec, f"failed to start on node: {e}")
+        threading.Thread(target=run, daemon=True, name="actor-schedule").start()
 
     def _h_actor_ready(self, msg, frames):
         with self._lock:

@@ -36,9 +36,9 @@ MAX_SPILLBACKS = 4
 
 class _Worker:
     __slots__ = ("worker_id", "proc", "address", "idle", "current_task",
-                 "actor_id", "ready")
+                 "actor_id", "ready", "acquired", "tpu")
 
-    def __init__(self, worker_id: bytes, proc):
+    def __init__(self, worker_id: bytes, proc, tpu: bool = False):
         self.worker_id = worker_id
         self.proc = proc
         self.address = None
@@ -46,6 +46,11 @@ class _Worker:
         self.current_task = None  # TaskSpec being executed
         self.actor_id = None  # set for dedicated actor workers
         self.ready = threading.Event()
+        # resources this worker currently holds (task or actor); released
+        # exactly once on finish/death (reference: LocalResourceManager
+        # instance accounting, raylet/scheduling/local_resource_manager.h:55)
+        self.acquired: dict[str, float] = {}
+        self.tpu = tpu  # spawned with TPU device visibility
 
 
 class Nodelet:
@@ -80,6 +85,20 @@ class Nodelet:
         self._view_ts = 0.0
         self._stopped = threading.Event()
         self._dispatch_wake = threading.Event()
+        # At-least-once RPC dedup: schedule_task may be retried by a
+        # submitter whose first reply was slow (not lost); executing the
+        # same TaskSpec twice duplicates side effects. Keyed by
+        # (task_id, attempt, spillback_count) so legitimate retries and
+        # respill hops pass. Bounded FIFO eviction.
+        self._seen_tasks: set[tuple] = set()
+        self._seen_tasks_order: deque[tuple] = deque()
+        # Worker-pool cap (reference: WorkerPool caps by cores,
+        # raylet/worker_pool.h:216). Actors get dedicated processes and
+        # are gated by resources instead.
+        env_cap = os.environ.get("RAY_TPU_MAX_WORKERS")
+        self._max_task_workers = (int(env_cap) if env_cap else
+                                  max(2, int(self.resources.get("CPU", 0) or
+                                             (os.cpu_count() or 8))))
 
         s = self.server
         s.register("schedule_task", self._h_schedule_task)
@@ -156,7 +175,7 @@ class Nodelet:
 
     # ------------------------------------------------------------ workers
 
-    def _spawn_worker(self, actor_spec_blob: bytes | None = None) -> _Worker:
+    def _spawn_worker(self, tpu: bool = False) -> _Worker:
         from ray_tpu.core.ids import WorkerID
 
         wid = WorkerID.random().binary()
@@ -167,21 +186,29 @@ class Nodelet:
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
         env["RAY_TPU_WORKER_ID"] = wid.hex()
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
-        # Workers must never grab the (single) TPU by default; tasks that
-        # need the chip opt in via resources (driver holds the device).
-        # Dropping the axon pool env also skips the sitecustomize jax
-        # import (~2s saved per worker spawn); the original value is
-        # preserved for workers that legitimately claim the TPU.
-        if "PALLAS_AXON_POOL_IPS" in env:
-            env["RAY_TPU_AXON_POOL_IPS"] = env.pop("PALLAS_AXON_POOL_IPS")
-        env.setdefault("JAX_PLATFORMS", "cpu")
+        if tpu:
+            # Worker legitimately claims the TPU resource: hand the chip
+            # through (reference: TPU_VISIBLE_CHIPS management,
+            # _private/accelerators/tpu.py:157-170).
+            env.pop("JAX_PLATFORMS", None)
+            if "RAY_TPU_AXON_POOL_IPS" in env:
+                env["PALLAS_AXON_POOL_IPS"] = env["RAY_TPU_AXON_POOL_IPS"]
+        else:
+            # Workers must never grab the (single) TPU by default; tasks
+            # that need the chip opt in via resources (driver holds the
+            # device). Dropping the axon pool env also skips the
+            # sitecustomize jax import (~2s saved per worker spawn); the
+            # original value is preserved for TPU-claiming workers above.
+            if "PALLAS_AXON_POOL_IPS" in env:
+                env["RAY_TPU_AXON_POOL_IPS"] = env.pop("PALLAS_AXON_POOL_IPS")
+            env["JAX_PLATFORMS"] = "cpu"
         log = open(os.path.join(self.log_dir, f"worker-{wid.hex()[:12]}.log"), "ab")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_main"],
             env=env, stdout=log, stderr=subprocess.STDOUT,
             start_new_session=True,
         )
-        w = _Worker(wid, proc)
+        w = _Worker(wid, proc, tpu=tpu)
         with self._lock:
             self._workers[wid] = w
         return w
@@ -217,9 +244,13 @@ class Nodelet:
 
     def _on_worker_death(self, w: _Worker):
         rc = w.proc.returncode
-        if w.current_task is not None:
-            spec = w.current_task
-            self._release(spec)
+        self._release_worker_resources(w)
+        # atomically take current_task: _requeue_or_fail (push timeout path)
+        # and this reap path must not BOTH report a retryable failure, or
+        # the owner resubmits twice and the task runs twice
+        with self._lock:
+            spec, w.current_task = w.current_task, None
+        if spec is not None:
             try:
                 self.client.send_oneway(spec.owner, "task_done", {
                     "task_id": spec.task_id,
@@ -237,11 +268,28 @@ class Nodelet:
                                  timeout=10)
             except Exception:
                 pass
+        self._dispatch_wake.set()
+
+    def _release_worker_resources(self, w: _Worker):
+        with self._lock:
+            acquired, w.acquired = w.acquired, {}
+            for r, q in acquired.items():
+                self._available[r] = min(self.resources.get(r, 0.0),
+                                         self._available.get(r, 0.0) + q)
 
     # ------------------------------------------------------------ scheduling
 
     def _h_schedule_task(self, msg, frames):
         spec = TaskSpec(**msg["spec"])
+        # dedup at-least-once deliveries (submitter retries on slow reply)
+        key = (spec.task_id, spec.attempt, spec.spillback_count)
+        with self._lock:
+            if key in self._seen_tasks:
+                return {"queued": "duplicate"}
+            self._seen_tasks.add(key)
+            self._seen_tasks_order.append(key)
+            while len(self._seen_tasks_order) > 20000:
+                self._seen_tasks.discard(self._seen_tasks_order.popleft())
         target = self._place(spec)
         if target == "local":
             with self._lock:
@@ -307,21 +355,24 @@ class Nodelet:
     def _can_run(self, req: dict) -> bool:
         return all(self._available.get(r, 0.0) >= q for r, q in req.items())
 
-    def _acquire(self, spec: TaskSpec) -> bool:
-        req = {} if spec.placement_group is not None else spec.resources
+    def _task_req(self, spec: TaskSpec) -> dict:
+        if spec.placement_group is not None:
+            # PG tasks are metered against their bundle reservation
+            # (reference: bundle resources are committed at PG creation;
+            # tasks inside the group consume from the bundle, not the
+            # node's free pool — gcs_placement_group_manager.h:228).
+            return {}
+        return spec.resources
+
+    def _acquire_for(self, w: _Worker, req: dict) -> bool:
         with self._lock:
             if not self._can_run(req):
                 return False
             for r, q in req.items():
                 self._available[r] -= q
-            return True
-
-    def _release(self, spec: TaskSpec):
-        req = {} if spec.placement_group is not None else spec.resources
-        with self._lock:
             for r, q in req.items():
-                self._available[r] = min(self.resources.get(r, 0.0),
-                                         self._available[r] + q)
+                w.acquired[r] = w.acquired.get(r, 0.0) + q
+            return True
 
     def _dispatch_loop(self):
         """The dispatch hot loop (reference:
@@ -335,21 +386,52 @@ class Nodelet:
                     if not self._queue:
                         break
                     spec = self._queue[0]
-                    if not self._acquire(spec):
+                    req = self._task_req(spec)
+                    if not self._can_run(req):
                         break
-                    self._queue.popleft()
+                    needs_tpu = spec.resources.get("TPU", 0) > 0
                     w = None
-                    while self._idle_workers:
-                        cand = self._idle_workers.popleft()
-                        if cand.worker_id in self._workers:
+                    # reuse-first: prefer an idle worker whose device
+                    # visibility matches the task's TPU claim
+                    for cand in list(self._idle_workers):
+                        if cand.worker_id in self._workers and \
+                                cand.tpu == needs_tpu:
                             w = cand
+                            self._idle_workers.remove(cand)
                             break
-                    if w is not None:
-                        w.idle = False
-                        w.current_task = spec
+                    if w is None:
+                        n_task_workers = sum(
+                            1 for x in self._workers.values()
+                            if x.actor_id is None)
+                        if n_task_workers >= self._max_task_workers:
+                            # capped. Any idle worker here has the wrong
+                            # device visibility — evict one to make room;
+                            # if all are busy, wait for task_finished.
+                            victim = None
+                            for cand in list(self._idle_workers):
+                                if cand.worker_id in self._workers:
+                                    victim = cand
+                                    self._idle_workers.remove(cand)
+                                    self._workers.pop(cand.worker_id, None)
+                                    break
+                            if victim is None:
+                                break
+                            try:
+                                victim.proc.terminate()
+                            except Exception:
+                                pass
+                    # acquire BEFORE the (slow) worker spawn so racing
+                    # submitters see the true availability and spill
+                    for r, q in req.items():
+                        self._available[r] -= q
+                    self._queue.popleft()
                 if w is None:
-                    w = self._spawn_worker()
-                    w.current_task = spec
+                    w = self._spawn_worker(tpu=needs_tpu)
+                with self._lock:
+                    for r, q in req.items():
+                        w.acquired[r] = w.acquired.get(r, 0.0) + q
+                w.idle = False
+                w.current_task = spec
                 threading.Thread(target=self._push_task, args=(w, spec),
                                  daemon=True).start()
 
@@ -364,8 +446,11 @@ class Nodelet:
             self._requeue_or_fail(w, spec, f"push failed: {e}")
 
     def _requeue_or_fail(self, w: _Worker, spec: TaskSpec, cause: str):
-        self._release(spec)
-        w.current_task = None
+        with self._lock:
+            taken, w.current_task = w.current_task, None
+        if taken is None:
+            return  # the reap path already reported this task's failure
+        self._release_worker_resources(w)
         try:
             self.client.send_oneway(spec.owner, "task_done", {
                 "task_id": spec.task_id,
@@ -381,12 +466,11 @@ class Nodelet:
             w = self._workers.get(msg["worker_id"])
         if w is None:
             return
-        spec = w.current_task
-        if spec is not None:
-            self._release(spec)
+        self._release_worker_resources(w)
         w.current_task = None
         with self._lock:
-            if w.worker_id in self._workers and w.actor_id is None:
+            if w.worker_id in self._workers and w.actor_id is None and \
+                    not w.idle:
                 w.idle = True
                 self._idle_workers.append(w)
         self._dispatch_wake.set()
@@ -397,12 +481,21 @@ class Nodelet:
         spec = ActorSpec(**msg["spec"])
         spec.cls_blob = frames[0] if frames else spec.cls_blob
         req = {} if spec.placement_group is not None else spec.resources
+        needs_tpu = spec.resources.get("TPU", 0) > 0
         with self._lock:
+            # cheap refusal BEFORE the (expensive) process spawn: the head
+            # retries placement on refusal, which must not churn processes
             if not self._can_run(req):
                 raise RuntimeError(f"insufficient resources for actor: {req}")
-            for r, q in req.items():
-                self._available[r] -= q
-        w = self._spawn_worker()
+        w = self._spawn_worker(tpu=needs_tpu)
+        if not self._acquire_for(w, req):
+            with self._lock:
+                self._workers.pop(w.worker_id, None)
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+            raise RuntimeError(f"insufficient resources for actor: {req}")
         w.actor_id = spec.actor_id
 
         def push():
@@ -468,7 +561,13 @@ class Nodelet:
             self.store.release(oid)
 
     def _h_free_object(self, msg, frames):
+        """Owner dropped its last reference: drop the creator/primary pin
+        (held since create+seal so eviction can't reclaim live objects —
+        reference: raylet pins primary copies) and reclaim the space if no
+        reader still holds a zero-copy view; otherwise the entry falls to
+        the LRU list when the last reader releases."""
         try:
+            self.store.release(msg["oid"])
             self.store.delete(msg["oid"])
         except Exception:
             pass

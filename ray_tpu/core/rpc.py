@@ -65,6 +65,16 @@ def _chaos_init():
 _chaos_budget = _chaos_init()
 
 
+def set_chaos(spec: str):
+    """(Re)arm deterministic RPC drop budgets in THIS process at runtime
+    (tests; same format as the env var: "method=N,method2=M"). Reference:
+    rpc/rpc_chaos.h:23."""
+    global _chaos_budget
+    os.environ["RAY_TPU_TESTING_RPC_FAILURE"] = spec
+    with _chaos_lock:
+        _chaos_budget = _chaos_init()
+
+
 def _chaos_should_drop(method: str) -> bool:
     if not _chaos_budget:
         return False
@@ -79,6 +89,32 @@ def _chaos_should_drop(method: str) -> bool:
 # ---------------------------------------------------------------- server
 
 
+def node_ip() -> str:
+    """The IP this node's services bind and advertise.
+
+    Default loopback; set RAY_TPU_NODE_IP to a routable interface address
+    (or "auto" for non-loopback autodetection) so head/nodelet/worker
+    RPC endpoints are reachable from other hosts (reference: address
+    selection in python/ray/_private/services.py)."""
+    ip = os.environ.get("RAY_TPU_NODE_IP", "").strip()
+    if not ip:
+        return "127.0.0.1"
+    if ip != "auto":
+        return ip
+    import socket
+
+    try:
+        # UDP connect doesn't send packets; it just picks the route.
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
 class RpcServer:
     """One ROUTER socket; handlers run on a thread pool.
 
@@ -87,13 +123,18 @@ class RpcServer:
     Register one-way handlers with `oneway=True` — no reply is sent.
     """
 
-    def __init__(self, name: str = "rpc", num_threads: int = 16):
+    def __init__(self, name: str = "rpc", num_threads: int = 16,
+                 bind_ip: str | None = None):
         self._ctx = zmq.Context.instance()
         self._sock = self._ctx.socket(zmq.ROUTER)
         self._sock.setsockopt(zmq.LINGER, 0)
         self._sock.setsockopt(zmq.ROUTER_MANDATORY, 0)
-        port = self._sock.bind_to_random_port("tcp://127.0.0.1")
-        self.address = f"127.0.0.1:{port}"
+        ip = bind_ip or node_ip()
+        # Bind all interfaces when advertising a routable address so the
+        # same port also serves loopback peers on this host.
+        bind_addr = "tcp://*" if ip != "127.0.0.1" else "tcp://127.0.0.1"
+        port = self._sock.bind_to_random_port(bind_addr)
+        self.address = f"{ip}:{port}"
         self._handlers: dict[str, tuple] = {}
         self._pool = ThreadPoolExecutor(max_workers=num_threads,
                                         thread_name_prefix=f"{name}-h")
@@ -266,17 +307,21 @@ class RpcClient:
 
     def call_async(self, address: str, method: str, msg: dict | None = None,
                    frames: list = ()) -> Future:
+        return self._call_async_traced(address, method, msg, frames)[1]
+
+    def _call_async_traced(self, address: str, method: str,
+                           msg: dict | None = None, frames: list = ()):
         peer = self._peer(address)
         msg_id = self._next_id()
         fut: Future = Future()
         with peer.pending_lock:
             peer.pending[msg_id] = fut
         if _chaos_should_drop(method):
-            return fut  # simulated network drop: caller's timeout/retry fires
+            return msg_id, fut  # simulated drop: caller's timeout/retry fires
         payload = ser.dumps_msg(msg or {})
         with peer.send_lock:
             peer.sock.send_multipart([msg_id, method.encode(), payload, *frames])
-        return fut
+        return msg_id, fut
 
     def call(self, address: str, method: str, msg: dict | None = None,
              frames: list = (), timeout: float = 30.0, retries: int = 0):
@@ -287,12 +332,20 @@ class RpcClient:
 
     def call_frames(self, address: str, method: str, msg: dict | None = None,
                     frames: list = (), timeout: float = 30.0, retries: int = 0):
+        import concurrent.futures as _cf
+
         last_exc = None
         for attempt in range(retries + 1):
-            fut = self.call_async(address, method, msg, frames)
+            msg_id, fut = self._call_async_traced(address, method, msg, frames)
             try:
+                # catch cf.TimeoutError explicitly: it only aliases builtin
+                # TimeoutError on python 3.11+
                 return fut.result(timeout=timeout)
-            except TimeoutError as e:
+            except (_cf.TimeoutError, TimeoutError) as e:
+                # drop the pending entry so timed-out ids don't leak
+                peer = self._peer(address)
+                with peer.pending_lock:
+                    peer.pending.pop(msg_id, None)
                 last_exc = PeerUnavailableError(
                     f"{method} to {address} timed out after {timeout}s")
                 last_exc.__cause__ = e

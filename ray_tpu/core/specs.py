@@ -39,6 +39,9 @@ class TaskSpec:
     max_retries: int = 3
     retry_exceptions: Any = False
     spillback_count: int = 0
+    # owner-side resubmission counter: distinguishes a legitimate retry
+    # of the same task_id from an at-least-once duplicate delivery
+    attempt: int = 0
     placement_group: bytes | None = None
     bundle_index: int = -1
     label_selector: dict | None = None

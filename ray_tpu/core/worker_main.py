@@ -42,6 +42,11 @@ class WorkerRuntime(ClusterRuntime):
         self._actor_instance = None
         self._actor_spec: ActorSpec | None = None
         self._actor_inbox: _queue.Queue = _queue.Queue()
+        # at-least-once dedup: callers retry actor_call on slow replies;
+        # executing the same method call twice corrupts actor state
+        self._seen_calls: set[bytes] = set()
+        self._seen_calls_order: list[bytes] = []
+        self._seen_lock = threading.Lock()
         self.server.register("execute_task", self._h_execute_task, oneway=True)
         self.server.register("become_actor", self._h_become_actor, oneway=True)
         self.server.register("actor_call", self._h_actor_call)
@@ -171,10 +176,24 @@ class WorkerRuntime(ClusterRuntime):
     def _h_actor_call(self, msg, frames):
         if self._actor_spec is None:
             raise exc.ActorUnavailableError("not an actor worker")
+        task_id = msg.get("task_id") or b""
+        if task_id:
+            with self._seen_lock:
+                if task_id in self._seen_calls:
+                    return {"queued": True, "duplicate": True}
+                self._seen_calls.add(task_id)
+                self._seen_calls_order.append(task_id)
+                if len(self._seen_calls_order) > 20000:
+                    for old in self._seen_calls_order[:10000]:
+                        self._seen_calls.discard(old)
+                    del self._seen_calls_order[:10000]
         self._actor_inbox.put(msg)
         return {"queued": True}
 
     def _actor_exec_loop(self):
+        # execution threads carry the actor identity so user code can ask
+        # get_runtime_context() (reference: worker context per thread)
+        self._ctx.actor_id = ActorID(self._actor_spec.actor_id)
         while True:
             msg = self._actor_inbox.get()
             if msg is None:
@@ -183,6 +202,7 @@ class WorkerRuntime(ClusterRuntime):
             oids = msg["oids"]
             mname = msg["method"]
             task_id = msg.get("task_id", b"")
+            self._ctx.task_id = TaskID(task_id) if task_id else None
             try:
                 a, kw = self._decode_args(msg["args"], msg["kwargs"])
                 fn = getattr(self._actor_instance, mname)

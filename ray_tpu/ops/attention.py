@@ -37,16 +37,27 @@ def causal_attention_reference(
 
 def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     """Dispatch: pallas flash kernel on TPU for long sequences, reference
-    einsum elsewhere."""
+    einsum elsewhere. Failure to use the advertised kernel is LOUD (one
+    warning per process), never a silent O(T²) degradation."""
     T = q.shape[1]
     if T >= _FLASH_MIN_SEQ and _on_tpu():
         try:
             from ray_tpu.ops.flash_attention import flash_attention
 
             return flash_attention(q, k, v, causal=True)
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001
+            _warn_fallback(repr(e))
     return causal_attention_reference(q, k, v)
+
+
+@functools.cache
+def _warn_fallback(reason: str):
+    import warnings
+
+    warnings.warn(
+        f"pallas flash attention unavailable ({reason}); falling back to "
+        f"the O(T^2) einsum path — expect reduced MFU",
+        RuntimeWarning, stacklevel=3)
 
 
 @functools.cache

@@ -60,3 +60,12 @@ def axis_index(axis_name: str):
 
 def axis_size(axis_name: str):
     return jax.lax.axis_size(axis_name)
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+    """`jax.shard_map` with varying-manual-axes checking off by default:
+    collective-heavy SPMD bodies (all_gather outputs, ring schedules)
+    routinely produce values that are replicated at runtime but not
+    statically inferable, and jax>=0.8 rejects those under check_vma."""
+    return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=check_vma)

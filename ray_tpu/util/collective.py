@@ -13,12 +13,26 @@ covers: numpy arrays held by N separate actor/task processes. It runs
 over a rendezvous actor (per group) through the object store — correct
 everywhere, used for metadata barriers, weight broadcast, and CPU
 reductions, not for the training hot loop (which is in-program).
+
+Design notes (round-2 rewrite):
+- Group state is keyed by the *calling execution context* (actor id or
+  task id), not just the process: in local mode every member shares one
+  process, and per-process state made members overwrite each other's
+  rank (the round-1 hang).
+- The rendezvous protocol is two-phase and non-blocking on the actor:
+  `offer` records a contribution and returns immediately; members then
+  `poll` until the round's result is ready. No actor threads are ever
+  parked waiting on other members, so progress never depends on the
+  coordinator's max_concurrency.
+- `offer` is idempotent per (kind, seq, rank): at-least-once RPC
+  delivery (submitter retries) cannot corrupt a round.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Any
 
 import numpy as np
@@ -68,39 +82,34 @@ def _tree_scale(a, s):
 
 
 class _Rendezvous:
-    """Coordinator actor for one collective group. All ops are keyed by a
-    per-member monotonically increasing sequence number, so members may
-    pipeline ops without cross-talk."""
+    """Coordinator actor for one collective group. Rounds are keyed by
+    (kind, seq); members pipeline ops freely because every member keeps
+    its own monotonically increasing seq."""
 
     def __init__(self, world_size: int):
         self.world = world_size
         self._lock = threading.Lock()
-        self._rounds: dict[tuple, dict] = {}  # (kind, seq) -> state
+        self._rounds: dict[tuple, dict] = {}  # (kind, seq) -> round state
+        self._done: deque[tuple] = deque(maxlen=1024)  # completed round keys
+        self._done_set: set[tuple] = set()
         self._mail: dict[tuple, Any] = {}  # (src, dst, seq) -> payload
 
-    def _round(self, key):
+    def offer(self, kind: str, seq: int, rank: int, data, op: str | None,
+              root: int | None = None) -> bool:
+        """Record `rank`'s contribution to round (kind, seq). Returns
+        immediately; never blocks on other members."""
+        key = (kind, seq)
         with self._lock:
+            if key in self._done_set:
+                return True  # duplicate delivery of a finished round
             r = self._rounds.get(key)
             if r is None:
-                r = self._rounds[key] = {"data": {}, "event": threading.Event(),
-                                         "result": None, "done": 0}
-            return r
-
-    def _finish(self, key, r):
-        # last reader cleans up
-        with self._lock:
-            r["done"] += 1
-            if r["done"] >= self.world:
-                self._rounds.pop(key, None)
-
-    def contribute(self, kind: str, seq: int, rank: int, data, op: str | None,
-                   root: int | None = None):
-        key = (kind, seq)
-        r = self._round(key)
-        with self._lock:
+                r = self._rounds[key] = {"data": {}, "result": None,
+                                         "ready": False, "fetched": 0}
+            if rank in r["data"]:
+                return True  # duplicate contribution (RPC retry)
             r["data"][rank] = data
-            complete = len(r["data"]) == self.world
-            if complete and r["result"] is None:
+            if len(r["data"]) == self.world and not r["ready"]:
                 ordered = [r["data"][i] for i in range(self.world)]
                 if kind == "allreduce":
                     r["result"] = _REDUCERS[op](ordered)
@@ -111,29 +120,48 @@ class _Rendezvous:
                 elif kind == "barrier":
                     r["result"] = True
                 elif kind == "reducescatter":
-                    reduced = _REDUCERS[op](ordered)
-                    r["result"] = reduced
-                r["event"].set()
-        if not r["event"].wait(timeout=120):
-            raise TimeoutError(f"collective {kind}#{seq} timed out "
-                               f"({len(r['data'])}/{self.world} arrived)")
-        result = r["result"]
-        self._finish(key, r)
-        return result
+                    r["result"] = _REDUCERS[op](ordered)
+                r["ready"] = True
+        return True
+
+    def poll(self, kind: str, seq: int):
+        """(ready, result). Once every member has fetched, the round is
+        retired into the done-set so retried offers stay idempotent."""
+        key = (kind, seq)
+        with self._lock:
+            r = self._rounds.get(key)
+            if r is None:
+                # either unknown or already retired: treat retired rounds
+                # as an error (a member polled twice) — callers poll once.
+                return (False, None)
+            if not r["ready"]:
+                return (False, None)
+            result = r["result"]
+            r["fetched"] += 1
+            if r["fetched"] >= self.world:
+                self._rounds.pop(key, None)
+                self._done.append(key)
+                self._done_set.add(key)
+                while len(self._done) >= self._done.maxlen:
+                    old = self._done.popleft()
+                    self._done_set.discard(old)
+            return (True, result)
+
+    def progress(self, kind: str, seq: int) -> int:
+        with self._lock:
+            r = self._rounds.get((kind, seq))
+            return len(r["data"]) if r else -1
 
     def send(self, src: int, dst: int, seq: int, payload):
         with self._lock:
             self._mail[(src, dst, seq)] = payload
         return True
 
-    def recv(self, src: int, dst: int, seq: int, timeout: float = 120):
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            with self._lock:
-                if (src, dst, seq) in self._mail:
-                    return self._mail.pop((src, dst, seq))
-            time.sleep(0.002)
-        raise TimeoutError(f"recv from {src} (seq {seq}) timed out")
+    def try_recv(self, src: int, dst: int, seq: int):
+        with self._lock:
+            if (src, dst, seq) in self._mail:
+                return (True, self._mail.pop((src, dst, seq)))
+        return (False, None)
 
 
 class _GroupState:
@@ -151,8 +179,24 @@ class _GroupState:
         return s
 
 
-_groups: dict[str, _GroupState] = {}
+# Keyed by (context key, group name). The context key distinguishes
+# members that share one OS process (local mode, threaded actors).
+_groups: dict[tuple, _GroupState] = {}
 _groups_lock = threading.Lock()
+
+
+def _ctx_key() -> str:
+    import ray_tpu
+
+    try:
+        ctx = ray_tpu.get_runtime_context()
+    except Exception:
+        return "driver"
+    if ctx.actor_id is not None:
+        return f"a:{ctx.actor_id.hex()}"
+    if ctx.task_id is not None:
+        return f"t:{ctx.task_id.hex()}"
+    return "driver"
 
 
 def init_collective_group(world_size: int, rank: int,
@@ -167,19 +211,20 @@ def init_collective_group(world_size: int, rank: int,
     coord_cls = ray_tpu.remote(num_cpus=0)(_Rendezvous)
     coord = coord_cls.options(
         name=f"__collective_{group_name}", get_if_exists=True,
-        max_concurrency=max(4, 2 * world_size)).remote(world_size)
+        max_concurrency=max(4, world_size)).remote(world_size)
     with _groups_lock:
-        _groups[group_name] = _GroupState(group_name, world_size, rank, coord)
+        _groups[(_ctx_key(), group_name)] = _GroupState(
+            group_name, world_size, rank, coord)
     barrier(group_name)
 
 
 def is_group_initialized(group_name: str = "default") -> bool:
-    return group_name in _groups
+    return (_ctx_key(), group_name) in _groups
 
 
 def destroy_collective_group(group_name: str = "default"):
     with _groups_lock:
-        _groups.pop(group_name, None)
+        _groups.pop((_ctx_key(), group_name), None)
 
 
 def get_rank(group_name: str = "default") -> int:
@@ -191,20 +236,33 @@ def get_collective_group_size(group_name: str = "default") -> int:
 
 
 def _get(group_name) -> _GroupState:
-    g = _groups.get(group_name)
+    g = _groups.get((_ctx_key(), group_name))
     if g is None:
         raise RuntimeError(
-            f"collective group {group_name!r} not initialized in this process")
+            f"collective group {group_name!r} not initialized in this "
+            f"task/actor context")
     return g
 
 
-def _sync(g: _GroupState, kind, data, op=None, root=None):
+def _sync(g: _GroupState, kind, data, op=None, root=None,
+          timeout: float = 120.0):
     import ray_tpu
 
     seq = g.next_seq()
-    return ray_tpu.get(
-        g.coordinator.contribute.remote(kind, seq, g.rank, data, op, root),
-        timeout=180)
+    ray_tpu.get(g.coordinator.offer.remote(kind, seq, g.rank, data, op, root),
+                timeout=60)
+    deadline = time.monotonic() + timeout
+    sleep = 0.001
+    while time.monotonic() < deadline:
+        ready, result = ray_tpu.get(g.coordinator.poll.remote(kind, seq),
+                                    timeout=60)
+        if ready:
+            return result
+        time.sleep(sleep)
+        sleep = min(sleep * 2, 0.05)
+    arrived = ray_tpu.get(g.coordinator.progress.remote(kind, seq), timeout=60)
+    raise TimeoutError(f"collective {kind}#{seq} timed out "
+                       f"({arrived}/{g.world_size} arrived)")
 
 
 def allreduce(tensor, group_name: str = "default", op: str = ReduceOp.SUM):
@@ -220,7 +278,11 @@ def allgather(tensor, group_name: str = "default") -> list:
 
 
 def reducescatter(tensor, group_name: str = "default", op: str = ReduceOp.SUM):
-    """Each rank gets its 1/world shard (along axis 0) of the reduction."""
+    """Each rank gets its 1/world shard (along axis 0) of the reduction.
+
+    Note: the reduction rides through the coordinator whole (allreduce
+    cost); this path is for metadata/CPU tensors — in-program XLA
+    reduce_scatter (parallel/ops.py) is the device path."""
     g = _get(group_name)
     reduced = _sync(g, "reducescatter", tensor, op=op)
     return np.array_split(reduced, g.world_size, axis=0)[g.rank]
@@ -246,11 +308,20 @@ def send(tensor, dst_rank: int, group_name: str = "default"):
     ray_tpu.get(g.coordinator.send.remote(g.rank, dst_rank, seq, tensor))
 
 
-def recv(src_rank: int, group_name: str = "default"):
+def recv(src_rank: int, group_name: str = "default", timeout: float = 120.0):
     import ray_tpu
 
     g = _get(group_name)
     key = (src_rank, g.rank)
     seq = g.pt_seq.get(key, 0)
     g.pt_seq[key] = seq + 1
-    return ray_tpu.get(g.coordinator.recv.remote(src_rank, g.rank, seq))
+    deadline = time.monotonic() + timeout
+    sleep = 0.001
+    while time.monotonic() < deadline:
+        ok, payload = ray_tpu.get(
+            g.coordinator.try_recv.remote(src_rank, g.rank, seq), timeout=60)
+        if ok:
+            return payload
+        time.sleep(sleep)
+        sleep = min(sleep * 2, 0.05)
+    raise TimeoutError(f"recv from {src_rank} (seq {seq}) timed out")

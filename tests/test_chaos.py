@@ -1,0 +1,85 @@
+"""Deterministic RPC fault injection (reference model:
+src/ray/rpc/rpc_chaos.h:23 + RAY_testing_rpc_failure env — drop the
+first N sends of a method and assert the retry path recovers)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core import rpc
+
+
+@pytest.fixture
+def chaos_cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    rpc.set_chaos("")  # disarm
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_task_submit_survives_dropped_schedule_rpc(chaos_cluster):
+    """submit_task sends schedule_task with retries=2; dropping the first
+    send must be invisible to the caller, and the nodelet-side dedup must
+    not double-run the task when both the dropped-then-retried and any
+    slow duplicate arrive."""
+
+    @ray_tpu.remote(num_cpus=0.1)
+    def bump(x):
+        return x + 1
+
+    # warm up: function export + worker spawn happen without chaos
+    assert ray_tpu.get(bump.remote(1), timeout=60) == 2
+
+    rpc.set_chaos("schedule_task=1")
+    assert ray_tpu.get(bump.remote(10), timeout=60) == 11
+
+
+def test_actor_call_survives_dropped_rpc(chaos_cluster):
+    """Dropping the first actor_call send exercises the submit retry
+    loop; the worker-side task_id dedup keeps actor state correct even
+    when a retry races a slow (not lost) original."""
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+
+    # actor calls are at-most-once by default; retries are opt-in
+    # (reference: max_task_retries, python/ray/actor.py) — and the
+    # worker-side task_id dedup makes the opt-in retry exactly-once.
+    rpc.set_chaos("actor_call=1")
+    assert ray_tpu.get(c.incr.options(max_task_retries=2).remote(),
+                       timeout=120) == 2
+    rpc.set_chaos("")
+    # exactly-once effect: no hidden duplicate increment
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 3
+
+
+def test_resolve_retry_after_drop(chaos_cluster):
+    """Borrower resolve path retries after a dropped resolve RPC."""
+
+    @ray_tpu.remote(num_cpus=0.1)
+    def make():
+        return np.arange(10)
+
+    @ray_tpu.remote(num_cpus=0.1)
+    def consume(a):
+        return int(a.sum())
+
+    ref = make.remote()
+    assert ray_tpu.get(ref, timeout=60) is not None
+    rpc.set_chaos("resolve=1")
+    # worker resolving the borrowed arg hits its own (worker-process)
+    # chaos budget only via env; driver-side drop exercises our wait path
+    assert ray_tpu.get(consume.remote(ref), timeout=90) == 45

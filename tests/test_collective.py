@@ -110,8 +110,6 @@ def test_allreduce_pytree(ray_local):
 
 
 def test_in_program_collectives_on_mesh(cpu_mesh8):
-    import jax
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ray_tpu.parallel import ops
@@ -124,16 +122,14 @@ def test_in_program_collectives_on_mesh(cpu_mesh8):
         return s, g
 
     x = np.arange(8.0).reshape(8, 1)
-    fm = shard_map(f, mesh=mesh, in_specs=P(("data", "fsdp", "tensor")),
-                   out_specs=(P(), P(("data", "fsdp"))))
+    fm = ops.shard_map(f, mesh, in_specs=P(("data", "fsdp", "tensor")),
+                       out_specs=(P(), P(("data", "fsdp"))))
     s, g = fm(x)
     assert float(np.asarray(s)[0]) == x.sum()
     assert np.asarray(g).shape == (8, 1)
 
 
 def test_ring_shift(cpu_mesh8):
-    import jax
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ray_tpu.parallel import ops
@@ -144,6 +140,6 @@ def test_ring_shift(cpu_mesh8):
         return ops.ring_shift(x, "data", 1)
 
     x = np.arange(2.0).reshape(2, 1)
-    fm = shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    fm = ops.shard_map(f, mesh, in_specs=P("data"), out_specs=P("data"))
     out = np.asarray(fm(x)).ravel()
     assert out.tolist() == [1.0, 0.0]

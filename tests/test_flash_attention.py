@@ -1,0 +1,75 @@
+"""Flash-attention kernel vs the einsum reference (interpret mode on
+CPU — SURVEY.md §4: pure-logic kernel tests without hardware)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.attention import causal_attention_reference
+from ray_tpu.ops.flash_attention import flash_attention
+
+
+def _rand_qkv(key, B, T, H, D, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+    shape = (B, T, H, D)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+@pytest.mark.parametrize("T,block", [(256, 128), (128, 128), (256, 64)])
+def test_forward_matches_reference(T, block):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0), 2, T, 2, 64)
+    out = flash_attention(q, k, v, causal=True, block_q=block, block_k=block,
+                          interpret=True)
+    ref = causal_attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_forward_noncausal():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(1), 1, 128, 2, 32)
+    out = flash_attention(q, k, v, causal=False, block_q=64, block_k=64,
+                          interpret=True)
+    # non-causal reference
+    scale = 1.0 / (32 ** 0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    probs = jax.nn.softmax(logits, axis=-1)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_gradients_match_reference():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(2), 1, 128, 2, 32)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                            interpret=True)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_ref(q, k, v):
+        o = causal_attention_reference(q, k, v)
+        return jnp.sum(o * jnp.cos(o))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-4, err_msg=f"d{name}")
+
+
+def test_bfloat16_inputs():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(3), 1, 128, 2, 64, jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                          interpret=True)
+    ref = causal_attention_reference(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                               np.asarray(ref, dtype=np.float32),
+                               atol=3e-2, rtol=3e-2)
+
+
+def test_indivisible_seq_raises():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(4), 1, 96, 1, 32)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)

@@ -1,0 +1,70 @@
+"""RPC endpoints on routable (non-loopback) addresses.
+
+Reference parity: address selection/plumbing in
+python/ray/_private/services.py and node.py:1227 — the runtime must be
+able to span hosts. Tested with a loopback alias (127.0.0.2), the
+standard single-box stand-in for a second interface."""
+
+import os
+import socket
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import rpc
+
+
+def _alias_usable() -> bool:
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.bind(("127.0.0.2", 0))
+        s.close()
+        return True
+    except OSError:
+        return False
+
+
+@pytest.mark.skipif(not _alias_usable(), reason="no loopback alias")
+def test_cluster_on_nonloopback_address(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_NODE_IP", "127.0.0.2")
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        assert c.address.startswith("127.0.0.2:")
+        c.wait_for_nodes()
+        assert c.nodelets[0].address.startswith("127.0.0.2:")
+        ray_tpu.init(address=c.address)
+
+        @ray_tpu.remote(num_cpus=0.1)
+        def who():
+            return ray_tpu.get_runtime_context().node_id.hex()
+
+        assert ray_tpu.get(who.remote(), timeout=60) == \
+            c.nodelets[0].node_id.hex()
+
+        # worker env carries head/nodelet addresses on the alias
+        @ray_tpu.remote(num_cpus=0.1)
+        def addrs():
+            return (os.environ["RAY_TPU_HEAD_ADDR"],
+                    os.environ["RAY_TPU_NODELET_ADDR"])
+
+        head_addr, nodelet_addr = ray_tpu.get(addrs.remote(), timeout=60)
+        assert head_addr.startswith("127.0.0.2:")
+        assert nodelet_addr.startswith("127.0.0.2:")
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def test_node_ip_autodetect(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_NODE_IP", "auto")
+    ip = rpc.node_ip()
+    # any syntactically valid IPv4 is fine; must not crash offline
+    parts = ip.split(".")
+    assert len(parts) == 4 and all(p.isdigit() for p in parts)
+
+
+def test_default_is_loopback(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_NODE_IP", raising=False)
+    assert rpc.node_ip() == "127.0.0.1"
