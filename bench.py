@@ -18,6 +18,37 @@ import time
 
 BASELINE_TOKENS_PER_SEC_PER_CHIP = 30_000.0
 
+_PPO_SNIPPET = """
+import jax, json
+jax.config.update("jax_platforms", "cpu")
+from ray_tpu.rllib import PPOConfig
+algo = (PPOConfig().environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=16,
+                     rollout_fragment_length=128)
+        .training(num_sgd_iter=6, minibatch_size=256)).build()
+algo.train()
+rates = [algo.train()["env_steps_per_sec"] for _ in range(4)]
+print(json.dumps({"rate": max(rates)}))
+"""
+
+
+def _ppo_bench_subprocess() -> float:
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-c", _PPO_SNIPPET], capture_output=True,
+            text=True, timeout=300, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        line = out.stdout.strip().splitlines()[-1]
+        return float(_json.loads(line)["rate"])
+    except Exception:
+        return 0.0
+
 
 def main():
     import jax
@@ -84,6 +115,12 @@ def main():
     # MFU against v5e peak 197 TFLOP/s bf16 (fwd+bwd ~ 6*N flops/token)
     mfu = 6.0 * n_params * per_chip / 197e12 if on_tpu else 0.0
 
+    # secondary: RLlib PPO sampling+learning throughput. The env loop and
+    # small-MLP learner are host-side by design (BASELINE north star
+    # names PPO env-steps/sec) — run in a CPU subprocess so the measure
+    # is not distorted by the TPU tunnel's per-dispatch latency.
+    ppo_steps_per_sec = _ppo_bench_subprocess()
+
     print(
         json.dumps(
             {
@@ -101,6 +138,7 @@ def main():
                     "step_ms": round(1e3 * dt / steps, 1),
                     "mfu": round(mfu, 3),
                     "loss": round(final_loss, 4),
+                    "ppo_env_steps_per_sec": round(ppo_steps_per_sec, 0),
                 },
             }
         )

@@ -38,13 +38,13 @@ class ObjectRef:
         self.owner = owner
         rt = _runtime
         if rt is not None:
-            rt._incref(id)
+            rt._incref(id, owner)
 
     def __del__(self):
         rt = _runtime
         if rt is not None:
             try:
-                rt._decref(self.id)
+                rt._decref(self.id, self.owner)
             except Exception:
                 pass
 

@@ -44,7 +44,7 @@ class _Owned:
     """State of an object this process owns."""
 
     __slots__ = ("event", "inline", "value_cached", "has_cached", "location",
-                 "store_name", "error", "spec", "retries_left", "served_borrow",
+                 "store_name", "error", "spec", "retries_left", "borrowers",
                  "cancelled")
 
     def __init__(self, spec: TaskSpec | None = None, retries_left: int = 0):
@@ -57,7 +57,10 @@ class _Owned:
         self.error: BaseException | None = None
         self.spec = spec
         self.retries_left = retries_left
-        self.served_borrow = False
+        # rpc addresses of processes borrowing this object's store bytes;
+        # the owner keeps the value alive until every borrower releases
+        # (reference: borrower bookkeeping, core_worker/reference_count.h:66)
+        self.borrowers: set[str] = set()
         self.cancelled = False
 
 
@@ -85,6 +88,14 @@ class ClusterRuntime:
         self._exported_fns: set[str] = set()
         self._actor_addr: dict[bytes, str] = {}
         self._actor_meta: dict[bytes, dict] = {}
+        # in-flight actor calls by actor: when an actor dies/restarts, its
+        # pending calls must fail fast with ActorDiedError instead of
+        # leaving the owner waiting forever (reference: ActorTaskSubmitter
+        # DisconnectActor fails inflight tasks, actor_task_submitter.h:75)
+        self._inflight_actor: dict[bytes, dict[bytes, list[bytes]]] = {}
+        self._task_actor: dict[bytes, bytes] = {}  # task_id -> actor_id
+        # objects we borrow (store bytes owned elsewhere): oid -> owner
+        self._borrowed_owner: dict[bytes, str] = {}
         # Store buffers pinned because a deserialized object graph aliases
         # them zero-copy (plasma pin semantics); released when the owning
         # object is freed or at shutdown.
@@ -99,6 +110,8 @@ class ClusterRuntime:
         self.server = RpcServer(name=f"rt-{mode}", num_threads=32)
         self.server.register("task_done", self._h_task_done, oneway=True)
         self.server.register("resolve", self._h_resolve)
+        self.server.register("borrow_release", self._h_borrow_release,
+                             oneway=True)
         self.server.register("pubsub", self._h_pubsub, oneway=True)
         self.server.register("ping", lambda m, f: "pong")
         self.address = self.server.address
@@ -165,12 +178,12 @@ class ClusterRuntime:
 
     # ------------------------------------------------------------ refcounting
 
-    def _incref(self, oid):
+    def _incref(self, oid, owner: str | None = None):
         b = oid.binary() if hasattr(oid, "binary") else oid
         with self._lock:
             self._refcounts[b] = self._refcounts.get(b, 0) + 1
 
-    def _decref(self, oid):
+    def _decref(self, oid, owner: str | None = None):
         b = oid.binary() if hasattr(oid, "binary") else oid
         with self._lock:
             c = self._refcounts.get(b, 0) - 1
@@ -179,10 +192,29 @@ class ClusterRuntime:
                 return
             self._refcounts.pop(b, None)
             st = self._owned.get(b)
-            if st is None or not st.event.is_set() or st.served_borrow:
-                return  # pending results / borrowed objects stay
-            self._owned.pop(b, None)
+            if st is None:
+                # not ours: if we registered a borrow, tell the owner the
+                # last local reference is gone (reference: borrower->owner
+                # release, core_worker/reference_count.h:66). The pin
+                # release and the network send happen OUTSIDE the lock —
+                # _decref runs at arbitrary GC points.
+                borrowed_from = self._borrowed_owner.pop(b, None)
+            else:
+                if not st.event.is_set() or st.borrowers:
+                    return  # pending / actively borrowed objects stay
+                self._owned.pop(b, None)
+                borrowed_from = None
         self._release_pin(b)
+        if st is not None:
+            self._free_remote_bytes(st, b)
+        elif borrowed_from is not None:
+            try:
+                self.client.send_oneway(borrowed_from, "borrow_release",
+                                        {"oid": b, "borrower": self.address})
+            except Exception:
+                pass
+
+    def _free_remote_bytes(self, st: "_Owned", b: bytes):
         with self._lock:
             if st.location is not None and self.nodelet_address:
                 try:
@@ -259,7 +291,8 @@ class ClusterRuntime:
             t = self._remaining(deadline)
             try:
                 value, frames = self.client.call_frames(
-                    owner, "resolve", {"oid": b, "wait": True},
+                    owner, "resolve",
+                    {"oid": b, "wait": True, "borrower": self.address},
                     timeout=min(t, 5.0) if t is not None else 5.0)
             except PeerUnavailableError as e:
                 if "timed out" in str(e):
@@ -274,6 +307,11 @@ class ClusterRuntime:
             if status == "inline":
                 return ser.deserialize(memoryview(frames[0]))
             if status == "location":
+                # the owner registered us as a borrower atomically while
+                # serving this resolve (no free window between reply and
+                # registration); remember who to release to
+                with self._lock:
+                    self._borrowed_owner[b] = owner
                 return self._materialize(b, None, value["location"],
                                          value.get("store_name"))
             raise exc.ObjectLostError(f"{ref}: owner reports {status}")
@@ -385,11 +423,18 @@ class ClusterRuntime:
             st.event.wait(timeout=4.5)
         if not st.event.is_set():
             return {"status": "pending"}
-        st.served_borrow = True
         if st.error is not None:
             return {"status": "error"}, [ser.dumps_msg(st.error)]
         if st.inline is not None:
             return {"status": "inline"}, [st.inline]
+        borrower = msg.get("borrower")
+        if borrower:
+            # register atomically with the location handout: the bytes
+            # stay pinned until this borrower sends borrow_release
+            with self._lock:
+                if self._owned.get(msg["oid"]) is not st:
+                    return {"status": "unknown"}  # freed while we waited
+                st.borrowers.add(borrower)
         if st.location == "local":
             # owner-local store: hand out bytes directly (borrower may be
             # anywhere; its nodelet pulls from our nodelet)
@@ -401,9 +446,30 @@ class ClusterRuntime:
     def store_name_of(self, st):
         return self.store.name if self.store is not None else st.store_name
 
+    def _h_borrow_release(self, msg, frames):
+        b = msg["oid"]
+        with self._lock:
+            st = self._owned.get(b)
+            if st is None:
+                return
+            st.borrowers.discard(msg["borrower"])
+            if st.borrowers or self._refcounts.get(b, 0) > 0 or \
+                    not st.event.is_set():
+                return
+            self._owned.pop(b, None)
+        self._release_pin(b)
+        self._free_remote_bytes(st, b)
+
     def _h_task_done(self, msg, frames):
         oids = msg["oids"]
         task_id = msg.get("task_id") or b""
+        if task_id:
+            with self._lock:
+                ab = self._task_actor.pop(task_id, None)
+                if ab is not None:
+                    pend = self._inflight_actor.get(ab)
+                    if pend is not None:
+                        pend.pop(task_id, None)
         err_blob = msg.get("error")
         if err_blob is not None:
             try:
@@ -439,6 +505,14 @@ class ClusterRuntime:
                 if st is not None and st.spec is not None:
                     spec = st.spec
                     break
+            # first-writer-wins: a late failure report (e.g. the nodelet
+            # reaping a worker that already delivered its result directly)
+            # must neither re-execute nor clobber a completed task
+            done = [b for b in oids
+                    if (s := self._owned.get(b)) is not None
+                    and s.event.is_set()]
+            if done:
+                return True  # treat as handled; results already delivered
         if spec is not None and retryable:
             with self._lock:
                 st0 = self._owned.get(spec.return_oids[0])
@@ -462,7 +536,7 @@ class ClusterRuntime:
         for b in oids:
             with self._lock:
                 st = self._owned.get(b)
-            if st is not None:
+            if st is not None and not st.event.is_set():
                 st.error = error
                 st.event.set()
         return False
@@ -476,6 +550,19 @@ class ClusterRuntime:
                     self._actor_addr.pop(aid, None)
                 elif data["event"] == "ready":
                     self._actor_addr[aid] = data["address"]
+            if data["event"] in ("dead", "restarting"):
+                # calls in flight on the lost incarnation will never get a
+                # task_done: fail them now (at-most-once semantics)
+                with self._lock:
+                    pend = self._inflight_actor.pop(aid, {})
+                    for tid in pend:
+                        self._task_actor.pop(tid, None)
+                cause = data.get("cause", "actor died")
+                for tid, oids in pend.items():
+                    self._error_oids(
+                        oids, exc.ActorDiedError(
+                            f"actor died with call in flight: {cause}"))
+                    self._unpin_task_args(tid)
             if data["event"] == "dead":
                 self._unpin_task_args(aid)
 
@@ -689,6 +776,13 @@ class ClusterRuntime:
                 self._unpin_task_args(task_id)
                 last_err = None
                 break
+            # register BEFORE the push: a fast task_done must find the
+            # entry to pop, or it leaks until actor death (and is then
+            # spuriously failure-processed)
+            with self._lock:
+                self._inflight_actor.setdefault(ab, {})[task_id] = \
+                    [o.binary() for o in oids]
+                self._task_actor[task_id] = ab
             try:
                 self.client.call(addr, "actor_call", msg, timeout=30)
                 last_err = None
@@ -696,6 +790,10 @@ class ClusterRuntime:
             except PeerUnavailableError as e:
                 last_err = e
                 with self._lock:
+                    pend = self._inflight_actor.get(ab)
+                    if pend is not None:
+                        pend.pop(task_id, None)
+                    self._task_actor.pop(task_id, None)
                     self._actor_addr.pop(ab, None)  # force re-resolve
                 time.sleep(0.2)
         if last_err is not None:
@@ -710,7 +808,9 @@ class ClusterRuntime:
         for b in oids:
             with self._lock:
                 st = self._owned.get(b)
-            if st is not None:
+            if st is not None and not st.event.is_set():
+                # first writer wins: never clobber a delivered result with
+                # a late failure signal (e.g. pubsub death racing task_done)
                 st.error = error
                 st.event.set()
 

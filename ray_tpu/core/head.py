@@ -82,13 +82,17 @@ class Head:
         s.register("create_pg", self._h_create_pg)
         s.register("pg_table", self._h_pg_table)
         s.register("remove_pg", self._h_remove_pg)
+        s.register("list_actors", self._h_list_actors)
         s.register("ping", lambda m, f: "pong")
         self._monitor = threading.Thread(target=self._monitor_loop, daemon=True,
                                          name="head-monitor")
+        self._pg_retry = threading.Thread(target=self._pg_retry_loop,
+                                          daemon=True, name="head-pg-retry")
 
     def start(self):
         self.server.start()
         self._monitor.start()
+        self._pg_retry.start()
         return self
 
     def stop(self):
@@ -369,6 +373,25 @@ class Head:
                          allow_restart=not no_restart)
         return {}
 
+    def _h_list_actors(self, msg, frames):
+        """State API source (reference: `ray list actors`,
+        python/ray/util/state/api.py backed by the GCS actor table)."""
+        with self._lock:
+            out = []
+            for aid, rec in self._actors.items():
+                out.append({
+                    "actor_id": aid.hex(),
+                    "class_name": rec.spec.name or "",
+                    "name": rec.spec.name,
+                    "namespace": rec.spec.namespace,
+                    "state": rec.state,
+                    "address": rec.address,
+                    "node_id": rec.node_id.hex() if rec.node_id else None,
+                    "restarts_left": rec.restarts_left,
+                    "death_cause": rec.death_cause,
+                })
+        return {"actors": out}
+
     # ------------------------------------------------------------ pubsub
 
     def _h_subscribe(self, msg, frames):
@@ -397,6 +420,22 @@ class Head:
             nodes = [n for n in self._nodes.values() if n.alive]
             avail = dict(self._available)
         return create_pg(self, self._pgs, msg, nodes, avail)
+
+    def _pg_retry_loop(self):
+        """PENDING placement groups are replanned as the cluster changes
+        (node added, resources released) — reference: the GCS keeps a
+        pending queue and reschedules, gcs_placement_group_manager.h:228."""
+        from ray_tpu.core.placement import PGState, retry_pending_pgs
+
+        while not self._stopped.wait(0.5):
+            with self._lock:
+                pending = [r for r in self._pgs.values()
+                           if r.state == PGState.PENDING]
+                if not pending:
+                    continue
+                nodes = [n for n in self._nodes.values() if n.alive]
+                avail = dict(self._available)
+            retry_pending_pgs(self, pending, nodes, avail)
 
     def _h_pg_table(self, msg, frames):
         from ray_tpu.core.placement import pg_info

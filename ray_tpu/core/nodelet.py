@@ -36,7 +36,7 @@ MAX_SPILLBACKS = 4
 
 class _Worker:
     __slots__ = ("worker_id", "proc", "address", "idle", "current_task",
-                 "actor_id", "ready", "acquired", "tpu")
+                 "actor_id", "ready", "acquired", "tpu", "bundle")
 
     def __init__(self, worker_id: bytes, proc, tpu: bool = False):
         self.worker_id = worker_id
@@ -50,6 +50,7 @@ class _Worker:
         # exactly once on finish/death (reference: LocalResourceManager
         # instance accounting, raylet/scheduling/local_resource_manager.h:55)
         self.acquired: dict[str, float] = {}
+        self.bundle = None  # ((pg_id, idx), resources) for PG-metered work
         self.tpu = tpu  # spawned with TPU device visibility
 
 
@@ -80,9 +81,11 @@ class Nodelet:
         self._queue: deque[TaskSpec] = deque()
         self._workers: dict[bytes, _Worker] = {}
         self._idle_workers: deque[_Worker] = deque()
-        self._bundles: dict[tuple, dict] = {}  # (pg_id, idx) -> resources
+        self._bundles: dict[tuple, dict] = {}  # (pg_id, idx) -> reserved
+        self._bundle_free: dict[tuple, dict] = {}  # (pg_id, idx) -> remaining
         self._cluster_view = []
         self._view_ts = 0.0
+        self._pull_chunks_served = 0  # chunked-transfer observability
         self._stopped = threading.Event()
         self._dispatch_wake = threading.Event()
         # At-least-once RPC dedup: schedule_task may be retried by a
@@ -107,6 +110,8 @@ class Nodelet:
         s.register("worker_ready", self._h_worker_ready)
         s.register("task_finished", self._h_task_finished, oneway=True)
         s.register("fetch_object", self._h_fetch_object)
+        s.register("object_meta", self._h_object_meta)
+        s.register("pull_chunk", self._h_pull_chunk)
         s.register("pull_object", self._h_pull_object)
         s.register("free_object", self._h_free_object, oneway=True)
         s.register("reserve_bundle", self._h_reserve_bundle)
@@ -138,6 +143,11 @@ class Nodelet:
         }, timeout=30, retries=3)
         for t in self._threads:
             t.start()
+        # prestart warm workers (reference: WorkerPool prestart,
+        # worker_pool.h:216) — they register idle via worker_ready
+        n_prestart = int(os.environ.get("RAY_TPU_PRESTART_WORKERS", "0"))
+        for _ in range(min(n_prestart, self._max_task_workers)):
+            self._spawn_worker()
         return self
 
     def stop(self):
@@ -276,6 +286,26 @@ class Nodelet:
             for r, q in acquired.items():
                 self._available[r] = min(self.resources.get(r, 0.0),
                                          self._available.get(r, 0.0) + q)
+            bundle, w.bundle = w.bundle, None
+            if bundle is not None:
+                key, res = bundle
+                free = self._bundle_free.get(key)
+                cap = self._bundles.get(key)
+                if free is not None and cap is not None:
+                    for r, q in res.items():
+                        free[r] = min(cap.get(r, 0.0),
+                                      free.get(r, 0.0) + q)
+
+    def _fail_task(self, spec: TaskSpec, cause: str):
+        try:
+            self.client.send_oneway(spec.owner, "task_done", {
+                "task_id": spec.task_id,
+                "oids": spec.return_oids,
+                "error": ser.dumps_msg(ValueError(cause)),
+                "retryable": False,
+            })
+        except Exception:
+            pass
 
     # ------------------------------------------------------------ scheduling
 
@@ -364,6 +394,37 @@ class Nodelet:
             return {}
         return spec.resources
 
+    _BUNDLE_REJECT = "reject"
+
+    def _bundle_for(self, spec):
+        """Which local bundle a PG task/actor draws from. Returns the
+        bundle key, None (bundle full — wait), or _BUNDLE_REJECT (the
+        request can NEVER fit the reservation). Caller holds self._lock."""
+        pg = spec.placement_group
+        req = spec.resources
+        if spec.bundle_index >= 0:
+            key = (pg, spec.bundle_index)
+            total = self._bundles.get(key)
+            if total is None:
+                return self._BUNDLE_REJECT  # bundle not on this node
+            if any(total.get(r, 0.0) < q for r, q in req.items()):
+                return self._BUNDLE_REJECT
+            free = self._bundle_free[key]
+            if all(free.get(r, 0.0) >= q for r, q in req.items()):
+                return key
+            return None
+        feasible = False
+        for key, total in self._bundles.items():
+            if key[0] != pg:
+                continue
+            if any(total.get(r, 0.0) < q for r, q in req.items()):
+                continue
+            feasible = True
+            free = self._bundle_free[key]
+            if all(free.get(r, 0.0) >= q for r, q in req.items()):
+                return key
+        return None if feasible else self._BUNDLE_REJECT
+
     def _acquire_for(self, w: _Worker, req: dict) -> bool:
         with self._lock:
             if not self._can_run(req):
@@ -382,54 +443,79 @@ class Nodelet:
             self._dispatch_wake.wait(timeout=0.05)
             self._dispatch_wake.clear()
             while True:
+                reject = None
                 with self._lock:
                     if not self._queue:
                         break
                     spec = self._queue[0]
                     req = self._task_req(spec)
-                    if not self._can_run(req):
-                        break
-                    needs_tpu = spec.resources.get("TPU", 0) > 0
-                    w = None
-                    # reuse-first: prefer an idle worker whose device
-                    # visibility matches the task's TPU claim
-                    for cand in list(self._idle_workers):
-                        if cand.worker_id in self._workers and \
-                                cand.tpu == needs_tpu:
-                            w = cand
-                            self._idle_workers.remove(cand)
+                    bundle_key = None
+                    if spec.placement_group is not None:
+                        bundle_key = self._bundle_for(spec)
+                        if bundle_key is None:
+                            break  # bundle full: wait for a release
+                        if bundle_key == self._BUNDLE_REJECT:
+                            self._queue.popleft()
+                            reject = spec
+                    if reject is None:
+                        if not self._can_run(req):
                             break
-                    if w is None:
-                        n_task_workers = sum(
-                            1 for x in self._workers.values()
-                            if x.actor_id is None)
-                        if n_task_workers >= self._max_task_workers:
-                            # capped. Any idle worker here has the wrong
-                            # device visibility — evict one to make room;
-                            # if all are busy, wait for task_finished.
-                            victim = None
-                            for cand in list(self._idle_workers):
-                                if cand.worker_id in self._workers:
-                                    victim = cand
-                                    self._idle_workers.remove(cand)
-                                    self._workers.pop(cand.worker_id, None)
-                                    break
-                            if victim is None:
+                        needs_tpu = spec.resources.get("TPU", 0) > 0
+                        w = None
+                        # reuse-first: prefer an idle worker whose device
+                        # visibility matches the task's TPU claim
+                        for cand in list(self._idle_workers):
+                            if cand.worker_id in self._workers and \
+                                    cand.tpu == needs_tpu:
+                                w = cand
+                                self._idle_workers.remove(cand)
                                 break
-                            try:
-                                victim.proc.terminate()
-                            except Exception:
-                                pass
-                    # acquire BEFORE the (slow) worker spawn so racing
-                    # submitters see the true availability and spill
-                    for r, q in req.items():
-                        self._available[r] -= q
-                    self._queue.popleft()
+                        if w is None:
+                            n_task_workers = sum(
+                                1 for x in self._workers.values()
+                                if x.actor_id is None)
+                            if n_task_workers >= self._max_task_workers:
+                                # capped. Any idle worker here has the
+                                # wrong device visibility — evict one to
+                                # make room; if all busy, wait.
+                                victim = None
+                                for cand in list(self._idle_workers):
+                                    if cand.worker_id in self._workers:
+                                        victim = cand
+                                        self._idle_workers.remove(cand)
+                                        # keep it in _workers: the reap
+                                        # loop must poll() it or the child
+                                        # stays a zombie
+                                        victim.idle = False
+                                        break
+                                if victim is None:
+                                    break
+                                try:
+                                    victim.proc.terminate()
+                                except Exception:
+                                    pass
+                        # acquire BEFORE the (slow) worker spawn so racing
+                        # submitters see the true availability and spill
+                        for r, q in req.items():
+                            self._available[r] -= q
+                        if bundle_key is not None:
+                            free = self._bundle_free[bundle_key]
+                            for r, q in spec.resources.items():
+                                free[r] = free.get(r, 0.0) - q
+                        self._queue.popleft()
+                if reject is not None:
+                    self._fail_task(
+                        reject,
+                        f"task resources {reject.resources} can never fit "
+                        f"its placement-group bundle reservation")
+                    continue
                 if w is None:
                     w = self._spawn_worker(tpu=needs_tpu)
                 with self._lock:
                     for r, q in req.items():
                         w.acquired[r] = w.acquired.get(r, 0.0) + q
+                    if bundle_key is not None:
+                        w.bundle = (bundle_key, dict(spec.resources))
                 w.idle = False
                 w.current_task = spec
                 threading.Thread(target=self._push_task, args=(w, spec),
@@ -482,20 +568,38 @@ class Nodelet:
         spec.cls_blob = frames[0] if frames else spec.cls_blob
         req = {} if spec.placement_group is not None else spec.resources
         needs_tpu = spec.resources.get("TPU", 0) > 0
+        bundle_key = None
         with self._lock:
             # cheap refusal BEFORE the (expensive) process spawn: the head
             # retries placement on refusal, which must not churn processes
             if not self._can_run(req):
                 raise RuntimeError(f"insufficient resources for actor: {req}")
+            if spec.placement_group is not None and spec.resources:
+                bundle_key = self._bundle_for(spec)
+                if bundle_key in (None, self._BUNDLE_REJECT):
+                    raise RuntimeError(
+                        f"actor resources {spec.resources} do not fit the "
+                        f"placement-group bundle")
+                free = self._bundle_free[bundle_key]
+                for r, q in spec.resources.items():
+                    free[r] = free.get(r, 0.0) - q
         w = self._spawn_worker(tpu=needs_tpu)
         if not self._acquire_for(w, req):
             with self._lock:
                 self._workers.pop(w.worker_id, None)
+                if bundle_key is not None:
+                    free = self._bundle_free.get(bundle_key)
+                    if free is not None:
+                        for r, q in spec.resources.items():
+                            free[r] = free.get(r, 0.0) + q
             try:
                 w.proc.terminate()
             except Exception:
                 pass
             raise RuntimeError(f"insufficient resources for actor: {req}")
+        if bundle_key is not None:
+            with self._lock:
+                w.bundle = (bundle_key, dict(spec.resources))
         w.actor_id = spec.actor_id
 
         def push():
@@ -528,6 +632,11 @@ class Nodelet:
 
     # ------------------------------------------------------------ objects
 
+    # Node-to-node transfers move in bounded chunks so a large object
+    # never needs 2x its size in transient buffers on either side
+    # (reference: chunked ObjectBufferPool transfers, object_manager.h:117)
+    PULL_CHUNK = 4 * 1024 * 1024
+
     def _h_fetch_object(self, msg, frames):
         """Ensure an object is present in the local store, pulling from
         the node given in `location` if needed (reference: PullManager,
@@ -538,18 +647,72 @@ class Nodelet:
         location = msg.get("location")
         if not location:
             return {"ok": False, "error": "no location"}
-        value, frames_in = self.client.call_frames(
-            location, "pull_object", {"oid": oid}, timeout=60, retries=2)
-        if not value.get("ok"):
-            return {"ok": False, "error": value.get("error", "pull failed")}
-        data = frames_in[0]
+        meta = self.client.call(location, "object_meta", {"oid": oid},
+                                timeout=30, retries=2)
+        if not meta.get("ok"):
+            return {"ok": False, "error": meta.get("error", "meta failed")}
+        size = meta["size"]
         try:
-            self.store.put(oid, data)
+            buf = self.store.create(oid, size)
         except KeyError:
-            pass  # concurrent fetch won
+            return {"ok": True}  # concurrent fetch won
+        except Exception as e:  # noqa: BLE001
+            return {"ok": False, "error": f"create failed: {e}"}
+        try:
+            off = 0
+            while off < size:
+                n = min(self.PULL_CHUNK, size - off)
+                value, frames_in = self.client.call_frames(
+                    location, "pull_chunk",
+                    {"oid": oid, "offset": off, "size": n},
+                    timeout=60, retries=2)
+                if not value.get("ok"):
+                    raise RuntimeError(value.get("error", "pull failed"))
+                buf[off:off + n] = frames_in[0]
+                off += n
+                self._pull_chunks_served += 1
+        except Exception as e:  # noqa: BLE001
+            del buf
+            try:
+                # delete WITHOUT sealing: sealing a half-written buffer
+                # would publish corrupt bytes to concurrent readers;
+                # rts_delete frees unsealed entries directly
+                self.store.delete(oid)
+            except Exception:
+                pass
+            return {"ok": False, "error": str(e)}
+        del buf
+        self.store.seal(oid)
+        # pulled copies are secondary: drop the creator pin so they are
+        # LRU-evictable (the primary stays pinned on the owner's node)
+        self.store.release(oid)
         return {"ok": True}
 
+    def _h_object_meta(self, msg, frames):
+        oid = msg["oid"]
+        v = self.store.get(oid)
+        if v is None:
+            return {"ok": False, "error": "absent"}
+        try:
+            return {"ok": True, "size": v.nbytes}
+        finally:
+            del v
+            self.store.release(oid)
+
+    def _h_pull_chunk(self, msg, frames):
+        oid = msg["oid"]
+        v = self.store.get(oid)
+        if v is None:
+            return {"ok": False, "error": "absent"}
+        try:
+            off, n = msg["offset"], msg["size"]
+            return {"ok": True}, [bytes(v[off:off + n])]
+        finally:
+            del v
+            self.store.release(oid)
+
     def _h_pull_object(self, msg, frames):
+        """Whole-object pull (small objects / direct driver fallback)."""
         oid = msg["oid"]
         v = self.store.get(oid)
         if v is None:
@@ -585,12 +748,14 @@ class Nodelet:
             for r, q in req.items():
                 self._available[r] -= q
             self._bundles[key] = dict(req)
+            self._bundle_free[key] = dict(req)
         return {"ok": True}
 
     def _h_release_bundle(self, msg, frames):
         key = (msg["pg_id"], msg["bundle_index"])
         with self._lock:
             req = self._bundles.pop(key, None)
+            self._bundle_free.pop(key, None)
             if req:
                 for r, q in req.items():
                     self._available[r] = min(self.resources.get(r, 0.0),

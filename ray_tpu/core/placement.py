@@ -29,7 +29,8 @@ class PGState:
 
 
 class PGRecord:
-    __slots__ = ("pg_id", "bundles", "strategy", "name", "nodes", "state", "cond")
+    __slots__ = ("pg_id", "bundles", "strategy", "name", "nodes", "state",
+                 "cond", "placing")
 
     def __init__(self, pg_id, bundles, strategy, name):
         self.pg_id = pg_id
@@ -39,6 +40,7 @@ class PGRecord:
         self.nodes = []  # node_id per bundle
         self.state = PGState.PENDING
         self.cond = threading.Condition()
+        self.placing = False  # one placer at a time (create vs retry loop)
 
 
 def _fits(avail: dict, req: dict) -> bool:
@@ -115,6 +117,25 @@ def create_pg(head, pgs: dict, msg: dict, nodes, avail) -> dict:
     rec = PGRecord(pg_id, msg["bundles"], msg.get("strategy", "PACK"),
                    msg.get("name"))
     pgs[pg_id] = rec
+    return _try_place(head, rec, nodes, avail)
+
+
+def _try_place(head, rec: PGRecord, nodes, avail) -> dict:
+    # single-placer guard: create_pg's own placement and the head's
+    # pending-retry loop must not reserve concurrently — the loser's
+    # reservations would leak (remove only releases rec.nodes)
+    with rec.cond:
+        if rec.state != PGState.PENDING or rec.placing:
+            return {"state": rec.state}
+        rec.placing = True
+    try:
+        return _try_place_locked_out(head, rec, nodes, avail)
+    finally:
+        with rec.cond:
+            rec.placing = False
+
+
+def _try_place_locked_out(head, rec: PGRecord, nodes, avail) -> dict:
     assign = _plan(rec.bundles, rec.strategy, nodes, avail)
     if assign is None:
         return {"state": PGState.PENDING}
@@ -126,7 +147,7 @@ def create_pg(head, pgs: dict, msg: dict, nodes, avail) -> dict:
     for i, nid in enumerate(assign):
         try:
             r = head.client.call(node_by_id[nid].address, "reserve_bundle",
-                                 {"pg_id": pg_id, "bundle_index": i,
+                                 {"pg_id": rec.pg_id, "bundle_index": i,
                                   "resources": rec.bundles[i]}, timeout=10)
             if not r.get("ok"):
                 ok = False
@@ -135,19 +156,41 @@ def create_pg(head, pgs: dict, msg: dict, nodes, avail) -> dict:
         except Exception:
             ok = False
             break
-    if not ok:
+    def rollback():
         for nid, i in reserved:
             try:
                 head.client.call(node_by_id[nid].address, "release_bundle",
-                                 {"pg_id": pg_id, "bundle_index": i}, timeout=10)
+                                 {"pg_id": rec.pg_id, "bundle_index": i},
+                                 timeout=10)
             except Exception:
                 pass
+
+    if not ok:
+        rollback()
         return {"state": PGState.PENDING}
     with rec.cond:
-        rec.nodes = assign
-        rec.state = PGState.CREATED
-        rec.cond.notify_all()
+        if rec.state == PGState.REMOVED:
+            # removed while the retry loop was placing: undo, or the
+            # reservation leaks forever
+            commit = False
+        else:
+            rec.nodes = assign
+            rec.state = PGState.CREATED
+            rec.cond.notify_all()
+            commit = True
+    if not commit:
+        rollback()
+        return {"state": PGState.REMOVED}
     return {"state": PGState.CREATED, "nodes": [n.hex() for n in assign]}
+
+
+def retry_pending_pgs(head, pending: list, nodes, avail):
+    """Replan PENDING groups against the freshest resource view (the
+    head's retry loop calls this off-lock; `avail` is a snapshot copy)."""
+    for rec in pending:
+        if rec.state != PGState.PENDING:
+            continue
+        _try_place(head, rec, nodes, {k: dict(v) for k, v in avail.items()})
 
 
 def pg_info(pgs: dict, pg_id=None) -> dict:
@@ -166,6 +209,10 @@ def remove_pg(head, pgs: dict, pg_id) -> dict:
     rec = pgs.get(pg_id)
     if rec is None:
         return {"removed": False}
+    with rec.cond:
+        # flip state first: a concurrent pending-retry placement observes
+        # REMOVED at commit time and rolls its reservations back
+        rec.state = PGState.REMOVED
     with head._lock:
         node_by_id = {n.node_id: n for n in head._nodes.values()}
     for i, nid in enumerate(rec.nodes):

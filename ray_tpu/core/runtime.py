@@ -112,11 +112,11 @@ class LocalRuntime:
     # Local reference counting driven by ObjectRef lifetime (reference:
     # ReferenceCounter, core_worker/reference_count.h:66). When the last
     # ObjectRef to an oid is GC'd, the stored value is dropped.
-    def _incref(self, oid: ObjectID):
+    def _incref(self, oid: ObjectID, owner=None):
         with self._objects_lock:
             self._refcounts[oid] = self._refcounts.get(oid, 0) + 1
 
-    def _decref(self, oid: ObjectID):
+    def _decref(self, oid: ObjectID, owner=None):
         with self._objects_lock:
             c = self._refcounts.get(oid, 0) - 1
             if c <= 0:

@@ -1,0 +1,10 @@
+"""ray_tpu.data — streaming datasets over the task runtime.
+
+Reference parity: ray.data (python/ray/data/) — lazy plans, block-based
+streaming execution with bounded in-flight work, map/map_batches/filter
+transforms, actor-pool compute, per-shard Train ingestion.
+"""
+
+from ray_tpu.data.dataset import Dataset, from_items, from_numpy, range
+
+__all__ = ["Dataset", "from_items", "from_numpy", "range"]

@@ -1,0 +1,52 @@
+"""Blocks — the unit of data movement.
+
+Reference parity: ray.data blocks (Arrow tables in plasma,
+data/_internal/arrow_block.py). Here a block is a list of rows (any
+python values; commonly dicts) living in the shared-memory object store
+as one object; batch formatting converts rows <-> dict-of-numpy columns
+on demand (numpy is the TPU-feeding format — jax.device_put consumes it
+zero-copy from the store where dtypes allow)."""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+import numpy as np
+
+Block = list  # a block is a list of rows
+
+
+def rows_to_batch(rows: list) -> Any:
+    """list of rows -> batch. Dict rows become dict-of-numpy columns;
+    scalar/array rows become one numpy array."""
+    if not rows:
+        return {}
+    if isinstance(rows[0], dict):
+        keys = rows[0].keys()
+        return {k: np.asarray([r[k] for r in rows]) for k in keys}
+    return np.asarray(rows)
+
+
+def batch_to_rows(batch: Any) -> list:
+    if isinstance(batch, dict):
+        if not batch:
+            return []
+        n = len(next(iter(batch.values())))
+        return [{k: v[i] for k, v in batch.items()} for i in range(n)]
+    return list(batch)
+
+
+def block_size_rows(block: Block) -> int:
+    return len(block)
+
+
+def split_blocks(items: Iterable, num_blocks: int) -> list[Block]:
+    items = list(items)
+    n = max(1, num_blocks)
+    base, rem = divmod(len(items), n)
+    out, i = [], 0
+    for b in range(n):
+        size = base + (1 if b < rem else 0)
+        out.append(items[i:i + size])
+        i += size
+    return [b for b in out if b] or [[]]

@@ -1,0 +1,232 @@
+"""Dataset — lazy plan + streaming execution over the task runtime.
+
+Reference parity: ray.data (python/ray/data/dataset.py:147): a Dataset
+is a lazy chain of operators over blocks; execution streams blocks
+through remote tasks with bounded in-flight work (the StreamingExecutor
+role, data/_internal/execution/streaming_executor.py:48), fusing
+consecutive map-like operators into one task per block the way the
+physical planner does. `compute="actors"` runs map_batches on a reusable
+actor pool (actor_pool_map_operator.py) for stateful/expensive-setup
+UDFs."""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Callable, Iterable, Iterator
+
+import numpy as np
+
+from ray_tpu.data.block import (
+    batch_to_rows,
+    rows_to_batch,
+    split_blocks,
+)
+
+_DEFAULT_PARALLELISM = 8
+
+
+class _Op:
+    """One logical operator: fn maps a block (list of rows) -> block."""
+
+    def __init__(self, kind: str, fn: Callable[[list], list]):
+        self.kind = kind
+        self.fn = fn
+
+
+def _fuse(ops: list[_Op]) -> Callable[[list], list]:
+    def fused(block: list) -> list:
+        for op in ops:
+            block = op.fn(block)
+        return block
+
+    return fused
+
+
+class Dataset:
+    def __init__(self, block_refs: list, ops: list[_Op] | None = None):
+        self._block_refs = block_refs  # ObjectRefs of input blocks
+        self._ops = ops or []
+
+    # ------------------------------------------------------------ create
+
+    @staticmethod
+    def from_items(items: Iterable, parallelism: int = _DEFAULT_PARALLELISM
+                   ) -> "Dataset":
+        import ray_tpu
+
+        blocks = split_blocks(items, parallelism)
+        return Dataset([ray_tpu.put(b) for b in blocks])
+
+    @staticmethod
+    def range(n: int, parallelism: int = _DEFAULT_PARALLELISM) -> "Dataset":
+        return Dataset.from_items(builtins.range(n), parallelism)
+
+    # ------------------------------------------------------------ transforms
+
+    def _with(self, op: _Op) -> "Dataset":
+        return Dataset(self._block_refs, self._ops + [op])
+
+    def map(self, fn: Callable) -> "Dataset":
+        return self._with(_Op("map", lambda b: [fn(r) for r in b]))
+
+    def filter(self, fn: Callable) -> "Dataset":
+        return self._with(_Op("filter", lambda b: [r for r in b if fn(r)]))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        return self._with(
+            _Op("flat_map", lambda b: [o for r in b for o in fn(r)]))
+
+    def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
+                    compute: str | None = None, num_actors: int = 2
+                    ) -> "Dataset":
+        def apply(block: list) -> list:
+            if not block:
+                return block
+            if batch_format == "numpy":
+                out = fn(rows_to_batch(block))
+                return batch_to_rows(out)
+            out = fn(block)
+            return list(out)
+
+        if compute == "actors":
+            ds = Dataset(self._block_refs, self._ops)
+            ds._actor_stage = (apply, num_actors)  # type: ignore[attr-defined]
+            return ds
+        return self._with(_Op("map_batches", apply))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        rows = self.take_all()
+        return Dataset.from_items(rows, num_blocks)
+
+    def shard(self, num_shards: int, index: int) -> "Dataset":
+        """Deterministic block-wise shard (per-host Train ingestion)."""
+        refs = [r for i, r in enumerate(self._block_refs)
+                if i % num_shards == index]
+        return Dataset(refs or [], list(self._ops))
+
+    def split(self, n: int) -> list["Dataset"]:
+        return [self.shard(n, i) for i in builtins.range(n)]
+
+    # ------------------------------------------------------------ execution
+
+    def _execute(self, max_in_flight: int | None = None) -> Iterator:
+        """Stream result block refs in input order with bounded in-flight
+        tasks (backpressure — streaming_executor.py:48)."""
+        import ray_tpu
+
+        actor_stage = getattr(self, "_actor_stage", None)
+        if not self._ops and actor_stage is None:
+            yield from self._block_refs
+            return
+        fused = _fuse(self._ops)
+        limit = max_in_flight or max(
+            2, int(ray_tpu.cluster_resources().get("CPU", 4)))
+
+        if actor_stage is None:
+            @ray_tpu.remote(num_cpus=1)
+            def _apply_block(block):
+                return fused(block)
+
+            pending: list = []
+            for ref in self._block_refs:
+                pending.append(_apply_block.remote(ref))
+                if len(pending) >= limit:
+                    yield pending.pop(0)
+            yield from pending
+            return
+
+        apply_fn, num_actors = actor_stage
+
+        import ray_tpu as rt
+
+        class _PoolWorker:
+            def apply(self, block):
+                return apply_fn(fused(block))
+
+        cls = rt.remote(num_cpus=1)(_PoolWorker)
+        actors = [cls.remote() for _ in builtins.range(num_actors)]
+        try:
+            pending = []
+            for i, ref in enumerate(self._block_refs):
+                a = actors[i % num_actors]
+                pending.append(a.apply.remote(ref))
+                if len(pending) >= limit:
+                    yield pending.pop(0)
+            yield from pending
+        finally:
+            for a in actors:
+                try:
+                    rt.kill(a)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def materialize(self) -> "Dataset":
+        import ray_tpu
+
+        refs = list(self._execute())
+        # re-put to pin materialized blocks under driver ownership
+        blocks = ray_tpu.get(refs, timeout=600)
+        return Dataset([ray_tpu.put(b) for b in blocks])
+
+    # ------------------------------------------------------------ consume
+
+    def iter_rows(self) -> Iterator:
+        import ray_tpu
+
+        for ref in self._execute():
+            yield from ray_tpu.get(ref, timeout=600)
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy") -> Iterator:
+        """Re-batch across block boundaries (reference:
+        data/_internal/iterator/)."""
+        buf: list = []
+        for row in self.iter_rows():
+            buf.append(row)
+            if len(buf) >= batch_size:
+                yield rows_to_batch(buf) if batch_format == "numpy" else buf
+                buf = []
+        if buf:
+            yield rows_to_batch(buf) if batch_format == "numpy" else buf
+
+    def take(self, n: int = 20) -> list:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> list:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        import ray_tpu
+
+        if not self._ops and getattr(self, "_actor_stage", None) is None:
+            return sum(len(b) for b in
+                       ray_tpu.get(list(self._block_refs), timeout=600))
+        return sum(1 for _ in self.iter_rows())
+
+    def num_blocks(self) -> int:
+        return len(self._block_refs)
+
+    def sum(self) -> Any:
+        return sum(self.iter_rows())
+
+    def __repr__(self):
+        ops = "->".join(o.kind for o in self._ops) or "source"
+        return f"Dataset(blocks={len(self._block_refs)}, plan={ops})"
+
+
+def from_items(items, parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
+    return Dataset.from_items(items, parallelism)
+
+
+def range(n: int, parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:  # noqa: A001
+    return Dataset.range(n, parallelism)
+
+
+def from_numpy(arr: np.ndarray, parallelism: int = _DEFAULT_PARALLELISM
+               ) -> Dataset:
+    return Dataset.from_items(list(arr), parallelism)

@@ -1,0 +1,76 @@
+"""Pipeline parallelism — GPipe-style microbatch schedule over the
+`pipe` mesh axis.
+
+SURVEY.md §7.8: PP is a first-class capability (the reference schedules
+frameworks that implement it; here it is native). TPU-native design:
+
+- stage parameters are stacked on a leading stage axis sharded over
+  `pipe` (one stage's weights per device group);
+- runs inside shard_map over the pipe axis: every device executes the
+  SAME program (XLA-friendly: no per-stage control flow); at schedule
+  tick t it applies its stage to the activation it holds, then the
+  activations rotate one hop with ppermute — stage i naturally works on
+  microbatch (t - i), the classic GPipe staircase with (S-1) bubble
+  ticks on each side;
+- microbatch m enters at stage 0 on tick m and exits stage S-1 on tick
+  m + S - 1; outputs are collected by masked accumulation, so the whole
+  schedule is one lax.scan (differentiable, no dynamic shapes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn, stage_params, x, axis_name: str = "pipe",
+                   num_microbatches: int | None = None) -> jax.Array:
+    """Run `stage_fn(params_i, h) -> h` for stages i = 0..S-1 as a
+    pipeline over the `axis_name` mesh axis.
+
+    Inside shard_map: `stage_params` is THIS device's stage slice (the
+    caller shards the stacked stage dim), `x` is the full batch
+    (replicated along the pipe axis), split into `num_microbatches`
+    equal microbatches along dim 0. Returns the full output batch.
+    """
+    S = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    B = x.shape[0]
+    M = num_microbatches or S
+    assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
+    mb = B // M
+    micro = x.reshape(M, mb, *x.shape[1:])
+
+    n_ticks = M + S - 1
+    # right-rotation by one hop: stage i sends to stage i+1
+    shift_perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(carry, t):
+        held, outputs = carry
+        # stage 0 ingests microbatch t (when in range) — other stages
+        # keep what arrived from their left neighbor
+        feed = micro[jnp.clip(t, 0, M - 1)]
+        held = jnp.where(stage == 0,
+                         jnp.where(t < M, feed, jnp.zeros_like(feed)),
+                         held)
+        out = stage_fn(stage_params, held)
+        # last stage emits microbatch (t - S + 1) when in range
+        m_out = t - (S - 1)
+        emit = jnp.logical_and(stage == S - 1,
+                               jnp.logical_and(m_out >= 0, m_out < M))
+        outputs = outputs.at[jnp.clip(m_out, 0, M - 1)].add(
+            jnp.where(emit, out, jnp.zeros_like(out)))
+        held = lax.ppermute(out, axis_name, shift_perm)
+        return (held, outputs), None
+
+    held0 = jnp.zeros_like(micro[0])
+    out0 = jnp.zeros((M, mb, *x.shape[1:]), x.dtype)
+    (_, outputs), _ = lax.scan(tick, (held0, out0), jnp.arange(n_ticks))
+    # outputs were produced only on the last stage; share them with every
+    # pipe rank so the result is replicated along the axis (psum over a
+    # one-hot contribution)
+    outputs = lax.psum(
+        jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name)
+    return outputs.reshape(B, *x.shape[1:])

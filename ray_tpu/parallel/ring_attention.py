@@ -1,0 +1,101 @@
+"""Ring attention — causal attention over a sequence-sharded mesh axis.
+
+Long-context context-parallelism (SURVEY.md §5: absent from the
+reference, which delegates sequence scaling to user frameworks; required
+here as a first-class capability). Design:
+
+- every device holds a (B, T/n, H, D) shard of q/k/v along the `seq`
+  mesh axis;
+- n ring steps: attend the local q block against the currently-held k/v
+  block with an online-softmax partial update (f32 statistics), then
+  ppermute the k/v block one hop around the ring — overlap-friendly on
+  TPU (ICI neighbor exchange), never materializing more than a
+  (T/n)x(T/n) score block per device;
+- block-level causality: a kv block strictly in the future contributes
+  nothing (its update is masked out); the diagonal block is masked
+  triangularly inside.
+
+Differentiable by construction (jnp ops + lax.scan + ppermute transpose)
+— no custom VJP needed. Use under shard_map with the `seq` axis; see
+ulysses_attention for the all-to-all alternative (head-sharded compute).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = -1e30
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = "seq", causal: bool = True) -> jax.Array:
+    """q,k,v: per-device (B, t, H, D) shards of a (B, T, H, D) global
+    array sharded on dim 1 over `axis_name`. Returns the matching output
+    shard. Call inside shard_map/pjit-manual over that axis."""
+    B, t, H, D = q.shape
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    scale = 1.0 / (D ** 0.5)
+    qf = q.astype(jnp.float32)
+
+    # positions of the local q rows / current kv cols within the GLOBAL seq
+    q_pos = my * t + jnp.arange(t)  # (t,)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, _):
+        o, m, l, kb, vb, src = carry
+        # which global block the held kv is: src (traced scalar)
+        kv_pos = src * t + jnp.arange(t)  # (t,)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32)) * scale
+        if causal:
+            mask = kv_pos[None, :] <= q_pos[:, None]  # (t_q, t_k)
+            s = jnp.where(mask[None, None], s, _NEG)
+        m_blk = jnp.max(s, axis=-1)  # (B,H,t)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(s - m_new[..., None])  # (B,H,t,t)
+        alpha = jnp.exp(m - m_new)  # (B,H,t)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, vb.astype(jnp.float32))
+        o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+        kb, vb = lax.ppermute((kb, vb), axis_name, perm)
+        src = (src - 1) % n  # after the shift we hold our neighbor's block
+        return (o_new, m_new, l_new, kb, vb, src), None
+
+    o0 = jnp.zeros((B, t, H, D), jnp.float32)
+    m0 = jnp.full((B, H, t), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, t), jnp.float32)
+    (o, m, l, _, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k, v, my), None, length=n)
+    l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (none in causal)
+    out = o / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = "seq", causal: bool = True,
+                      attn_fn=None) -> jax.Array:
+    """Ulysses-style sequence parallelism: all-to-all swaps the sharded
+    dimension from sequence to heads, runs FULL-sequence attention on
+    H/n heads per device, and swaps back. Cheaper than a ring when
+    H >= n and the full T fits per device; the all-to-all rides ICI.
+
+    q,k,v: per-device (B, T/n, H, D) shards -> same-shaped output shard.
+    `attn_fn(q,k,v)` runs the dense attention (defaults to the causal
+    einsum reference; pass the flash kernel on TPU)."""
+    if attn_fn is None:
+        from ray_tpu.ops.attention import causal_attention_reference
+
+        attn_fn = causal_attention_reference
+
+    def a2a(x, split, concat):
+        return lax.all_to_all(x, axis_name, split_axis=split,
+                              concat_axis=concat, tiled=True)
+
+    # (B, T/n, H, D) -> (B, T, H/n, D)
+    qh, kh, vh = (a2a(x, 2, 1) for x in (q, k, v))
+    oh = attn_fn(qh, kh, vh)
+    # back: (B, T, H/n, D) -> (B, T/n, H, D)
+    return a2a(oh, 1, 2)

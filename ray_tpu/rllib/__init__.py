@@ -1,0 +1,22 @@
+"""ray_tpu.rllib — reinforcement learning on the actor runtime.
+
+Reference parity: rllib/ new API stack — EnvRunner actors sampling
+gymnasium vector envs (env/single_agent_env_runner.py:64), a Learner
+whose update is a jitted SPMD program over a jax mesh
+(core/learner/learner.py:109, torch DDP wrap replaced by GSPMD), and
+Algorithm drivers starting with PPO (algorithms/ppo/ppo.py:389).
+"""
+
+from ray_tpu.rllib.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
+from ray_tpu.rllib.learner import PPOLearner, PPOLearnerConfig, compute_gae
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+
+__all__ = [
+    "EnvRunnerGroup",
+    "PPO",
+    "PPOConfig",
+    "PPOLearner",
+    "PPOLearnerConfig",
+    "SingleAgentEnvRunner",
+    "compute_gae",
+]

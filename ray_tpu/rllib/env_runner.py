@@ -1,0 +1,160 @@
+"""SingleAgentEnvRunner — vectorized env sampling with policy inference.
+
+Reference parity: rllib/env/single_agent_env_runner.py:64 (`sample`
+:139, hot loop `_sample` :243): gymnasium vector envs stepped against
+the current RLModule; here inference is a jitted CPU forward inside the
+actor process. Collected rollouts come back as flat numpy arrays (the
+connector-pipeline role of env→module/module→env formatting is inlined:
+CartPole-class observation spaces need no preprocessing)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SingleAgentEnvRunner:
+    """Runs as an actor (one per `num_env_runners`), or inline."""
+
+    def __init__(self, env: str = "CartPole-v1", num_envs: int = 1,
+                 rollout_fragment_length: int = 200, seed: int = 0,
+                 hidden=(64, 64)):
+        import gymnasium as gym
+        import jax
+
+        self._jax = jax
+        self.envs = gym.make_vec(env, num_envs=num_envs)
+        self.num_envs = num_envs
+        self.T = rollout_fragment_length
+        self.obs_dim = int(np.prod(self.envs.single_observation_space.shape))
+        self.n_actions = int(self.envs.single_action_space.n)
+        from ray_tpu.rllib import models
+
+        self._models = models
+        self.params = models.init_mlp_policy(
+            jax.random.PRNGKey(seed), self.obs_dim, self.n_actions, hidden)
+        self._sample_fn = jax.jit(models.sample_actions)
+        self._key = jax.random.PRNGKey(seed + 1)
+        self.obs, _ = self.envs.reset(seed=seed)
+        self._ep_returns = np.zeros(num_envs)
+        self._completed_returns: list[float] = []
+        self._env_steps_total = 0
+
+    # -- weights ---------------------------------------------------------
+
+    def set_weights(self, weights) -> bool:
+        """Weights arrive as host numpy pytrees (reference:
+        EnvRunnerGroup.sync_weights broadcast)."""
+        self.params = self._jax.tree.map(np.asarray, weights)
+        return True
+
+    def get_weights(self):
+        return self._jax.tree.map(np.asarray, self.params)
+
+    # -- sampling --------------------------------------------------------
+
+    def sample(self) -> dict:
+        """One rollout fragment of T steps across all envs. Returns flat
+        (T*num_envs, ...) arrays plus bootstrap values, and episode-return
+        stats for completed episodes."""
+        jax = self._jax
+        T, N = self.T, self.num_envs
+        obs_buf = np.empty((T, N, self.obs_dim), np.float32)
+        act_buf = np.empty((T, N), np.int64)
+        logp_buf = np.empty((T, N), np.float32)
+        val_buf = np.empty((T, N), np.float32)
+        rew_buf = np.empty((T, N), np.float32)
+        done_buf = np.empty((T, N), np.bool_)
+
+        obs = self.obs
+        for t in range(T):
+            self._key, k = jax.random.split(self._key)
+            action, logp, value = self._sample_fn(
+                self.params, obs.astype(np.float32), k)
+            action = np.asarray(action)
+            next_obs, reward, term, trunc, _ = self.envs.step(action)
+            done = np.logical_or(term, trunc)
+            obs_buf[t] = obs
+            act_buf[t] = action
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            rew_buf[t] = reward
+            done_buf[t] = done
+            self._ep_returns += reward
+            for i in np.nonzero(done)[0]:
+                self._completed_returns.append(float(self._ep_returns[i]))
+                self._ep_returns[i] = 0.0
+            obs = next_obs
+        self.obs = obs
+        self._env_steps_total += T * N
+        # bootstrap value for the final observation of each env
+        _, _, last_val = self._sample_fn(
+            self.params, obs.astype(np.float32), self._key)
+        completed = self._completed_returns[-100:]
+        self._completed_returns = completed  # keep a sliding window
+        return {
+            "obs": obs_buf,
+            "actions": act_buf,
+            "logp": logp_buf,
+            "values": val_buf,
+            "rewards": rew_buf,
+            "dones": done_buf,
+            "last_values": np.asarray(last_val),
+            "episode_return_mean": float(np.mean(completed)) if completed
+            else float("nan"),
+            "num_episodes": len(completed),
+            "env_steps": T * N,
+        }
+
+    def ping(self) -> str:
+        return "pong"
+
+
+class EnvRunnerGroup:
+    """Actor pool of env runners (reference:
+    rllib/env/env_runner_group.py:71 — foreach/weight sync)."""
+
+    def __init__(self, num_env_runners: int = 1, remote: bool = True,
+                 **runner_kwargs):
+        self.remote = remote and num_env_runners > 0
+        if not self.remote:
+            self.local = SingleAgentEnvRunner(**runner_kwargs)
+            self.runners = []
+            return
+        import ray_tpu
+
+        cls = ray_tpu.remote(num_cpus=1)(SingleAgentEnvRunner)
+        seed0 = runner_kwargs.pop("seed", 0)
+        self.runners = [
+            cls.remote(seed=seed0 + 1000 * i, **runner_kwargs)
+            for i in range(num_env_runners)
+        ]
+
+    def sample(self, timeout: float = 300.0) -> list[dict]:
+        if not self.remote:
+            return [self.local.sample()]
+        import ray_tpu
+
+        return ray_tpu.get([r.sample.remote() for r in self.runners],
+                           timeout=timeout)
+
+    def sync_weights(self, weights, timeout: float = 120.0):
+        """Broadcast learner weights (reference: weights ride the object
+        store once, not per-runner — ppo.py:455)."""
+        if not self.remote:
+            self.local.set_weights(weights)
+            return
+        import ray_tpu
+
+        ref = ray_tpu.put(weights)
+        ray_tpu.get([r.set_weights.remote(ref) for r in self.runners],
+                    timeout=timeout)
+
+    def shutdown(self):
+        import ray_tpu
+
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
+        self.runners = []

@@ -1,0 +1,60 @@
+"""RLModule equivalent: jax policy/value networks.
+
+Reference parity: RLModule (rllib/core/rl_module/rl_module.py:260 —
+forward_inference/_exploration/_train) + the default MLP catalog
+(rllib/core/models/catalog.py). Functional jax style: params are a
+pytree, `forward` is pure — the same function runs under jit in the
+learner (SPMD over the learner mesh) and on CPU inside env-runner
+actors."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_mlp_policy(key, obs_dim: int, n_actions: int,
+                    hidden=(64, 64)) -> dict:
+    """Separate policy and value MLP towers (reference default for
+    PPO-style actor-critic with vf_share_layers=False)."""
+
+    def tower(key, sizes):
+        params = []
+        for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+            key, k = jax.random.split(key)
+            scale = np.sqrt(2.0 / fan_in) if i < len(sizes) - 2 else 0.01
+            params.append({
+                "w": jax.random.normal(k, (fan_in, fan_out)) * scale,
+                "b": jnp.zeros((fan_out,)),
+            })
+        return params
+
+    kp, kv = jax.random.split(key)
+    return {
+        "pi": tower(kp, (obs_dim, *hidden, n_actions)),
+        "vf": tower(kv, (obs_dim, *hidden, 1)),
+    }
+
+
+def _mlp(layers, x):
+    for i, lyr in enumerate(layers):
+        x = x @ lyr["w"] + lyr["b"]
+        if i < len(layers) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+def forward(params: dict, obs: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """obs (B, obs_dim) -> (logits (B, A), value (B,))."""
+    logits = _mlp(params["pi"], obs)
+    value = _mlp(params["vf"], obs)[..., 0]
+    return logits, value
+
+
+def sample_actions(params: dict, obs: jax.Array, key) -> tuple:
+    """forward_exploration: sample from the categorical head."""
+    logits, value = forward(params, obs)
+    action = jax.random.categorical(key, logits)
+    logp = jax.nn.log_softmax(logits)[jnp.arange(logits.shape[0]), action]
+    return action, logp, value

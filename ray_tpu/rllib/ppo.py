@@ -1,0 +1,161 @@
+"""PPO — the first algorithm on the new stack.
+
+Reference parity: PPOConfig/PPO (rllib/algorithms/ppo/ppo.py:60,363,
+training_step :389): synchronous sampling from the EnvRunnerGroup →
+Learner update → weight sync back to the runners. The Learner update is
+one jitted SPMD program (learner.py here) instead of a DDP-wrapped torch
+module; `num_learners>1` maps to a bigger learner mesh, not more NCCL
+processes."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+import numpy as np
+
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.learner import PPOLearner, PPOLearnerConfig, compute_gae
+
+
+@dataclasses.dataclass
+class PPOConfig:
+    """Fluent builder (reference: AlgorithmConfig —
+    .environment().env_runners().training())."""
+
+    env: str = "CartPole-v1"
+    num_env_runners: int = 2
+    num_envs_per_env_runner: int = 8
+    rollout_fragment_length: int = 64
+    gamma: float = 0.99
+    lambda_: float = 0.95
+    lr: float = 3e-4
+    clip_param: float = 0.2
+    vf_loss_coeff: float = 0.5
+    entropy_coeff: float = 0.0
+    num_sgd_iter: int = 6
+    minibatch_size: int = 128
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    learner_mesh: Any = None  # jax Mesh for the SPMD learner update
+
+    def environment(self, env: str) -> "PPOConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, num_env_runners: int | None = None,
+                    num_envs_per_env_runner: int | None = None,
+                    rollout_fragment_length: int | None = None
+                    ) -> "PPOConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "PPOConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+class PPO:
+    """Algorithm driver (reference: Algorithm.step → PPO.training_step)."""
+
+    def __init__(self, config: PPOConfig):
+        self.config = config
+        self.env_runner_group = EnvRunnerGroup(
+            num_env_runners=config.num_env_runners,
+            remote=config.num_env_runners > 0,
+            env=config.env,
+            num_envs=config.num_envs_per_env_runner,
+            rollout_fragment_length=config.rollout_fragment_length,
+            seed=config.seed,
+            hidden=config.hidden,
+        )
+        # probe spaces locally (cheap, no env stepping)
+        import gymnasium as gym
+
+        probe = gym.make(config.env)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        n_actions = int(probe.action_space.n)
+        probe.close()
+        self.learner = PPOLearner(
+            obs_dim, n_actions,
+            PPOLearnerConfig(
+                lr=config.lr, clip_param=config.clip_param,
+                vf_loss_coeff=config.vf_loss_coeff,
+                entropy_coeff=config.entropy_coeff,
+                num_sgd_iter=config.num_sgd_iter,
+                minibatch_size=config.minibatch_size,
+                hidden=config.hidden),
+            mesh=config.learner_mesh, seed=config.seed)
+        self.env_runner_group.sync_weights(self.learner.get_weights())
+        self._iteration = 0
+        self._env_steps_total = 0
+
+    def train(self) -> dict:
+        """One training iteration (reference: PPO.training_step,
+        ppo.py:389 — sample, learn, sync)."""
+        t0 = time.perf_counter()
+        samples = self.env_runner_group.sample()
+        t_sample = time.perf_counter() - t0
+
+        # concatenate fragments; GAE per fragment (each has its own
+        # bootstrap values), then flatten (T, N) -> (T*N,)
+        obs, acts, logp, adv, targets = [], [], [], [], []
+        ep_returns, n_eps, env_steps = [], 0, 0
+        for s in samples:
+            a, tg = compute_gae(s["rewards"], s["values"], s["dones"],
+                                s["last_values"], self.config.gamma,
+                                self.config.lambda_)
+            obs.append(s["obs"].reshape(-1, s["obs"].shape[-1]))
+            acts.append(s["actions"].reshape(-1))
+            logp.append(s["logp"].reshape(-1))
+            adv.append(a.reshape(-1))
+            targets.append(tg.reshape(-1))
+            if s["num_episodes"]:
+                ep_returns.append(s["episode_return_mean"])
+                n_eps += s["num_episodes"]
+            env_steps += s["env_steps"]
+        train_batch = {
+            "obs": np.concatenate(obs).astype(np.float32),
+            "actions": np.concatenate(acts),
+            "logp_old": np.concatenate(logp),
+            "advantages": np.concatenate(adv),
+            "value_targets": np.concatenate(targets),
+        }
+        t1 = time.perf_counter()
+        learner_metrics = self.learner.update(train_batch)
+        t_learn = time.perf_counter() - t1
+        self.env_runner_group.sync_weights(self.learner.get_weights())
+
+        self._iteration += 1
+        self._env_steps_total += env_steps
+        dt = time.perf_counter() - t0
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": float(np.mean(ep_returns))
+            if ep_returns else float("nan"),
+            "num_episodes": n_eps,
+            "num_env_steps_sampled": env_steps,
+            "num_env_steps_sampled_lifetime": self._env_steps_total,
+            "env_steps_per_sec": env_steps / dt,
+            "time_sample_s": t_sample,
+            "time_learn_s": t_learn,
+            **{f"learner/{k}": v for k, v in learner_metrics.items()},
+        }
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def stop(self):
+        self.env_runner_group.shutdown()

@@ -1,0 +1,184 @@
+"""Cluster CLI.
+
+Reference parity: python/ray/scripts/scripts.py — `ray start --head`,
+`ray start --address`, `ray stop`, `ray status`, `ray list`. Usage:
+
+  python -m ray_tpu.scripts.cli start --head [--node-ip IP] \
+      [--num-cpus N] [--num-tpus N] [--resources JSON] [--block]
+  python -m ray_tpu.scripts.cli start --address HOST:PORT [...]
+  python -m ray_tpu.scripts.cli status  --address HOST:PORT
+  python -m ray_tpu.scripts.cli list {actors|nodes|pgs} --address ...
+  python -m ray_tpu.scripts.cli stop   [--session-dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+_DEFAULT_DIR = "/tmp/ray_tpu"
+
+
+def _pidfile(session_dir: str) -> str:
+    return os.path.join(session_dir, "cli_pids.json")
+
+
+def _record_pid(session_dir: str, role: str):
+    os.makedirs(session_dir, exist_ok=True)
+    path = _pidfile(session_dir)
+    pids = []
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                pids = json.load(f)
+        except (OSError, ValueError):
+            pids = []
+    pids.append({"pid": os.getpid(), "role": role, "t": time.time()})
+    with open(path, "w") as f:
+        json.dump(pids, f)
+
+
+def cmd_start(args):
+    if args.node_ip:
+        os.environ["RAY_TPU_NODE_IP"] = args.node_ip
+    from ray_tpu.core.head import Head
+    from ray_tpu.core.nodelet import Nodelet
+
+    session_dir = args.session_dir or os.path.join(
+        _DEFAULT_DIR, f"session_cli_{int(time.time())}")
+    os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+    res = json.loads(args.resources) if args.resources else {}
+    res.setdefault("CPU", float(args.num_cpus if args.num_cpus is not None
+                                else os.cpu_count() or 1))
+    if args.num_tpus:
+        res["TPU"] = float(args.num_tpus)
+
+    head = None
+    if args.head:
+        head = Head(session_name=os.path.basename(session_dir)).start()
+        head_address = head.address
+        print(f"head started at {head_address}")
+        print(f"connect with: ray_tpu.init(address={head_address!r})")
+    else:
+        if not args.address:
+            print("error: start needs --head or --address", file=sys.stderr)
+            return 2
+        head_address = args.address
+    nodelet = Nodelet(head_address, res,
+                      labels=json.loads(args.labels or "{}"),
+                      session_dir=session_dir).start()
+    print(f"nodelet started at {nodelet.address} with {res}")
+    if args.address_file:
+        tmp = args.address_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(head_address)
+        os.replace(tmp, args.address_file)
+    _record_pid(session_dir, "head+nodelet" if args.head else "nodelet")
+    if args.block or True:  # services are in-process threads: must block
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        nodelet.stop()
+        if head is not None:
+            head.stop()
+    return 0
+
+
+def cmd_status(args):
+    from ray_tpu.util import state
+
+    s = state.summarize(address=args.address)
+    print(f"nodes: {s['nodes_alive']} alive, {s['nodes_dead']} dead")
+    print(f"actors: {s['actors_alive']} alive / {s['actors_total']} total")
+    print("resources:")
+    for r, q in sorted(s["resources_total"].items()):
+        a = s["resources_available"].get(r, 0.0)
+        print(f"  {r}: {a:g}/{q:g} available")
+    return 0
+
+
+def cmd_list(args):
+    from ray_tpu.util import state
+
+    if args.kind == "actors":
+        rows = state.list_actors(address=args.address)
+    elif args.kind == "nodes":
+        rows = state.list_nodes(address=args.address)
+    elif args.kind == "pgs":
+        rows = state.list_placement_groups(address=args.address)
+    else:
+        print(f"unknown kind {args.kind}", file=sys.stderr)
+        return 2
+    print(json.dumps(rows, indent=2, default=str))
+    return 0
+
+
+def cmd_stop(args):
+    session_dir = args.session_dir
+    roots = ([session_dir] if session_dir else
+             [os.path.join(_DEFAULT_DIR, d)
+              for d in os.listdir(_DEFAULT_DIR)] if
+             os.path.isdir(_DEFAULT_DIR) else [])
+    n = 0
+    for root in roots:
+        path = _pidfile(root)
+        if not os.path.exists(path):
+            continue
+        try:
+            with open(path) as f:
+                pids = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for entry in pids:
+            try:
+                os.kill(entry["pid"], signal.SIGTERM)
+                n += 1
+            except ProcessLookupError:
+                pass
+        os.unlink(path)
+    print(f"stopped {n} process(es)")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="ray_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address")
+    p.add_argument("--node-ip")
+    p.add_argument("--num-cpus", type=float)
+    p.add_argument("--num-tpus", type=float)
+    p.add_argument("--resources")
+    p.add_argument("--labels")
+    p.add_argument("--session-dir")
+    p.add_argument("--address-file")
+    p.add_argument("--block", action="store_true")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("status")
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list")
+    p.add_argument("kind", choices=["actors", "nodes", "pgs"])
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("stop")
+    p.add_argument("--session-dir")
+    p.set_defaults(fn=cmd_stop)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,32 @@
+"""ray_tpu.serve — model serving on the actor runtime.
+
+Reference parity: ray.serve (python/ray/serve/) — `@serve.deployment`
+classes become groups of replica actors managed by a controller actor
+(_private/controller.py:84); requests route through a DeploymentHandle
+with least-queue replica choice (power-of-two-choices router,
+_private/router.py:318); an optional HTTP proxy exposes apps over REST
+(_private/proxy.py). Scoped to the serving core: deployments, replicas,
+handles, routing, HTTP ingress; autoscaling/app-graphs are future work.
+"""
+
+from ray_tpu.serve.api import (
+    Application,
+    Deployment,
+    DeploymentHandle,
+    delete,
+    deployment,
+    get_app_handle,
+    run,
+    shutdown,
+)
+
+__all__ = [
+    "Application",
+    "Deployment",
+    "DeploymentHandle",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "run",
+    "shutdown",
+]

@@ -1,0 +1,287 @@
+"""Serve core: deployments, controller, replicas, handles, HTTP.
+
+Reference parity mapped per class in docstrings; see package __init__.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any
+
+_CONTROLLER_NAME = "__serve_controller"
+
+
+@dataclasses.dataclass
+class Deployment:
+    """Produced by @serve.deployment; `.bind(*args)` freezes init args
+    into an Application (reference: serve/deployment.py:64)."""
+
+    cls_or_fn: Any
+    name: str
+    num_replicas: int = 1
+    ray_actor_options: dict | None = None
+    max_ongoing_requests: int = 16
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+    def options(self, **kw) -> "Deployment":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclasses.dataclass
+class Application:
+    deployment: Deployment
+    init_args: tuple
+    init_kwargs: dict
+
+
+def deployment(_cls=None, *, name: str | None = None, num_replicas: int = 1,
+               ray_actor_options: dict | None = None,
+               max_ongoing_requests: int = 16):
+    def wrap(cls):
+        return Deployment(cls, name or cls.__name__,
+                          num_replicas=num_replicas,
+                          ray_actor_options=ray_actor_options,
+                          max_ongoing_requests=max_ongoing_requests)
+
+    return wrap(_cls) if _cls is not None else wrap
+
+
+class _Replica:
+    """Replica actor: hosts one instance of the deployment class
+    (reference: replica actors, serve/_private/replica.py)."""
+
+    def __init__(self, cls_blob: bytes, args, kwargs):
+        import cloudpickle
+
+        cls = cloudpickle.loads(cls_blob)
+        self._instance = cls(*args, **kwargs) if isinstance(cls, type) \
+            else None
+        self._fn = None if isinstance(cls, type) else cls
+        self._ongoing = 0
+        self._lock = threading.Lock()
+
+    def handle_request(self, method: str, args, kwargs):
+        with self._lock:
+            self._ongoing += 1
+        try:
+            if self._fn is not None:
+                return self._fn(*args, **kwargs)
+            return getattr(self._instance, method)(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def ongoing(self) -> int:
+        return self._ongoing
+
+    def ping(self) -> str:
+        return "pong"
+
+
+class ServeController:
+    """Controller actor: owns the deployment -> replica-handles table and
+    reconciles replica counts (reference: _private/controller.py:84,
+    DeploymentStateManager)."""
+
+    def __init__(self):
+        self._apps: dict[str, dict] = {}  # app -> {replicas, deployment meta}
+
+    def deploy(self, app_name: str, cls_blob: bytes, num_replicas: int,
+               actor_options: dict | None, init_args, init_kwargs,
+               max_concurrency: int):
+        import ray_tpu
+
+        self.delete(app_name)
+        opts = dict(actor_options or {})
+        opts.setdefault("num_cpus", 0.1)
+        cls = ray_tpu.remote(**opts)(_Replica)
+        replicas = [
+            cls.options(max_concurrency=max(2, max_concurrency)).remote(
+                cls_blob, init_args, init_kwargs)
+            for _ in range(num_replicas)
+        ]
+        # readiness barrier: every replica constructed
+        ray_tpu.get([r.ping.remote() for r in replicas], timeout=120)
+        self._apps[app_name] = {"replicas": replicas,
+                                "num_replicas": num_replicas}
+        return True
+
+    def get_replicas(self, app_name: str):
+        app = self._apps.get(app_name)
+        return list(app["replicas"]) if app else []
+
+    def list_apps(self):
+        return {k: v["num_replicas"] for k, v in self._apps.items()}
+
+    def delete(self, app_name: str) -> bool:
+        import ray_tpu
+
+        app = self._apps.pop(app_name, None)
+        if not app:
+            return False
+        for r in app["replicas"]:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
+        return True
+
+    def shutdown(self):
+        for name in list(self._apps):
+            self.delete(name)
+        return True
+
+
+class DeploymentHandle:
+    """Client-side router (reference: DeploymentHandle + the
+    power-of-two-choices replica scheduler, _private/router.py:318 —
+    here: sample two replicas, pick the one with fewer ongoing
+    requests; falls back to round-robin when probing fails)."""
+
+    def __init__(self, app_name: str, replicas: list):
+        self.app_name = app_name
+        self._replicas = replicas
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def _pick(self):
+        import random
+
+        import ray_tpu
+
+        if len(self._replicas) == 1:
+            return self._replicas[0]
+        a, b = random.sample(self._replicas, 2)
+        try:
+            qa, qb = ray_tpu.get([a.ongoing.remote(), b.ongoing.remote()],
+                                 timeout=5)
+            return a if qa <= qb else b
+        except Exception:  # noqa: BLE001
+            with self._lock:
+                self._rr = (self._rr + 1) % len(self._replicas)
+                return self._replicas[self._rr]
+
+    def remote(self, *args, **kwargs):
+        return self._pick().handle_request.remote("__call__", args, kwargs)
+
+    def method(self, name: str):
+        def call(*args, **kwargs):
+            return self._pick().handle_request.remote(name, args, kwargs)
+
+        return call
+
+
+def _controller():
+    import ray_tpu
+
+    cls = ray_tpu.remote(num_cpus=0)(ServeController)
+    return cls.options(name=_CONTROLLER_NAME, get_if_exists=True,
+                       max_concurrency=8).remote()
+
+
+def run(app: Application, *, name: str = "default",
+        http_port: int | None = None) -> DeploymentHandle:
+    """Deploy an application; returns its handle (reference: serve.run)."""
+    import cloudpickle
+
+    import ray_tpu
+
+    ctrl = _controller()
+    dep = app.deployment
+    blob = cloudpickle.dumps(dep.cls_or_fn)
+    ray_tpu.get(ctrl.deploy.remote(
+        name, blob, dep.num_replicas, dep.ray_actor_options,
+        app.init_args, app.init_kwargs, dep.max_ongoing_requests),
+        timeout=180)
+    handle = get_app_handle(name)
+    if http_port is not None:
+        _start_http_proxy(http_port)
+    return handle
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    import ray_tpu
+
+    ctrl = _controller()
+    replicas = ray_tpu.get(ctrl.get_replicas.remote(name), timeout=60)
+    if not replicas:
+        raise ValueError(f"no serve application named {name!r}")
+    return DeploymentHandle(name, replicas)
+
+
+def delete(name: str = "default"):
+    import ray_tpu
+
+    ray_tpu.get(_controller().delete.remote(name), timeout=60)
+
+
+def shutdown():
+    import ray_tpu
+
+    try:
+        ctrl = ray_tpu.get_actor(_CONTROLLER_NAME)
+    except Exception:  # noqa: BLE001
+        return
+    try:
+        ray_tpu.get(ctrl.shutdown.remote(), timeout=60)
+        ray_tpu.kill(ctrl)
+    except Exception:  # noqa: BLE001
+        pass
+    _stop_http_proxy()
+
+
+# ---------------------------------------------------------------- HTTP
+
+_http_server = None
+_http_thread = None
+
+
+def _start_http_proxy(port: int):
+    """JSON-over-HTTP ingress in the driver process (reference: per-node
+    Proxy actors, _private/proxy.py; single proxy suffices single-host).
+    POST /<app> with a JSON body calls the app handle."""
+    global _http_server, _http_thread
+    import json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    import ray_tpu
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            app = self.path.strip("/") or "default"
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            try:
+                payload = json.loads(body) if body else None
+                handle = get_app_handle(app)
+                ref = handle.remote(payload)
+                result = ray_tpu.get(ref, timeout=120)
+                out = json.dumps({"result": result}).encode()
+                self.send_response(200)
+            except Exception as e:  # noqa: BLE001
+                out = json.dumps({"error": repr(e)}).encode()
+                self.send_response(500)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    _http_server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    _http_thread = threading.Thread(target=_http_server.serve_forever,
+                                    daemon=True, name="serve-http")
+    _http_thread.start()
+
+
+def _stop_http_proxy():
+    global _http_server, _http_thread
+    if _http_server is not None:
+        _http_server.shutdown()
+        _http_server = None
+        _http_thread = None
+

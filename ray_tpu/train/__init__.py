@@ -3,10 +3,42 @@
 TPU-native counterpart of ray.train (python/ray/train/): instead of N
 one-GPU workers forming an NCCL world via `dist.init_process_group`
 (train/torch/config.py:66-124), a training job is one SPMD program jitted
-over a device mesh; the worker group exists for multi-host process
-orchestration, data loading, and fault handling.
+over a device mesh spanning the worker gang (one jax process per host,
+jax.distributed rendezvous through the WorkerGroup); the worker group
+exists for multi-host process orchestration, data loading, checkpointing
+and fault handling.
 """
 
-from ray_tpu.train.spmd import TrainState, make_train_step, batch_shardings
+from ray_tpu.train.checkpoint import (
+    Checkpoint,
+    CheckpointConfig,
+    CheckpointManager,
+)
+from ray_tpu.train.session import get_checkpoint, get_context, report
+from ray_tpu.train.spmd import TrainState, batch_shardings, make_train_step
+from ray_tpu.train.trainer import (
+    FailureConfig,
+    JaxTrainer,
+    Result,
+    RunConfig,
+    ScalingConfig,
+    TrainingFailedError,
+)
 
-__all__ = ["TrainState", "make_train_step", "batch_shardings"]
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "CheckpointManager",
+    "FailureConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainState",
+    "TrainingFailedError",
+    "batch_shardings",
+    "get_checkpoint",
+    "get_context",
+    "make_train_step",
+    "report",
+]

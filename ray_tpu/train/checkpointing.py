@@ -1,0 +1,99 @@
+"""Saving/restoring sharded jax pytrees to a checkpoint directory.
+
+Reference parity: the role of torch.save/load inside Train user loops
+plus the storage layer (train/_internal/storage.py). TPU-native shape:
+state lives as sharded jax.Arrays across a process gang, so
+
+- `save_pytree`: every process participates (allgather of its shards via
+  jax.experimental.multihost_utils), rank 0 writes one .npz + a pickled
+  treedef. Simple and correct at test/GPT-2 scale; swap in per-shard
+  writes (orbax-style) for models that don't fit one host's RAM — the
+  directory format is versioned for that.
+- `load_pytree`: every process reads the (shared-fs) file and
+  re-device_puts with the target shardings, materializing only its own
+  shards (jax.make_array_from_callback).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import numpy as np
+
+_STATE_FILE = "state.npz"
+_TREE_FILE = "treedef.pkl"
+_FORMAT = 1
+
+
+def save_pytree(tree, directory: str, *, process_index: int | None = None):
+    """Collectively save a pytree of (possibly sharded) jax.Arrays.
+
+    Every process in the jax world MUST call this (the allgather of
+    non-addressable shards is collective). Only process 0 writes."""
+    from jax.experimental import multihost_utils
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    pid = jax.process_index() if process_index is None else process_index
+    multiproc = jax.process_count() > 1
+
+    host_leaves = []
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array) and multiproc and \
+                not leaf.is_fully_addressable:
+            leaf = multihost_utils.process_allgather(leaf, tiled=True)
+        host_leaves.append(np.asarray(leaf))
+
+    if pid == 0:
+        os.makedirs(directory, exist_ok=True)
+        tmp = os.path.join(directory, _STATE_FILE + ".tmp")
+        np.savez(tmp, **{f"leaf_{i}": a for i, a in enumerate(host_leaves)})
+        # np.savez appends .npz to a name without it
+        if not os.path.exists(tmp) and os.path.exists(tmp + ".npz"):
+            tmp = tmp + ".npz"
+        os.replace(tmp, os.path.join(directory, _STATE_FILE))
+        with open(os.path.join(directory, _TREE_FILE), "wb") as f:
+            pickle.dump({"format": _FORMAT, "treedef": treedef,
+                         "n_leaves": len(host_leaves)}, f)
+    if multiproc:
+        multihost_utils.sync_global_devices("ray_tpu_ckpt_save")
+
+
+def load_pytree(directory: str, shardings=None):
+    """Load a pytree saved by save_pytree. With `shardings` (a pytree of
+    NamedSharding matching the saved structure), each process
+    materializes only its addressable shards."""
+    with open(os.path.join(directory, _TREE_FILE), "rb") as f:
+        meta = pickle.load(f)
+    treedef = meta["treedef"]
+    data = np.load(os.path.join(directory, _STATE_FILE))
+    host_leaves = [data[f"leaf_{i}"] for i in range(meta["n_leaves"])]
+    if shardings is None:
+        return jax.tree_util.tree_unflatten(treedef, host_leaves)
+    shard_leaves = jax.tree_util.tree_leaves(shardings)
+    out = []
+    for arr, sh in zip(host_leaves, shard_leaves):
+        out.append(jax.make_array_from_callback(
+            arr.shape, sh, lambda idx, a=arr: a[idx]))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def save_train_state(state, directory: str):
+    """Convenience for ray_tpu.train.TrainState."""
+    save_pytree({"params": state.params, "opt_state": state.opt_state,
+                 "step": state.step}, directory)
+
+
+def load_train_state(directory: str, state_template):
+    """Restore into the shardings of `state_template` (a TrainState whose
+    arrays carry the target NamedShardings)."""
+    shardings = jax.tree.map(
+        lambda x: x.sharding if isinstance(x, jax.Array) else None,
+        {"params": state_template.params,
+         "opt_state": state_template.opt_state,
+         "step": state_template.step})
+    loaded = load_pytree(directory, shardings)
+    return type(state_template)(
+        params=loaded["params"], opt_state=loaded["opt_state"],
+        step=loaded["step"])

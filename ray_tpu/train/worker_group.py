@@ -1,0 +1,210 @@
+"""WorkerGroup — the gang of train-worker actors.
+
+Reference parity: ray.train._internal.worker_group.WorkerGroup
+(worker_group.py:102) + the actor-side _RayTrainWorker. Workers are
+actors placed in one placement group (gang semantics: all-or-nothing,
+strategy-shaped — backend_executor.py:142); each runs the user train
+function on a dedicated thread with a TrainSession and serves
+result-polling calls.
+
+TPU-first: one worker per HOST (a worker owns every chip the nodelet
+granted it), not one per device — a pod slice runs ONE SPMD program
+(SURVEY.md §7), so world_size == number of jax processes.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import traceback
+from typing import Any
+
+import cloudpickle
+
+
+class TrainWorker:
+    """Actor hosted in a worker process. One per train rank."""
+
+    def __init__(self, rank: int, world_size: int):
+        self.rank = rank
+        self.world_size = world_size
+        self.session = None
+
+    # -- rendezvous ------------------------------------------------------
+
+    def node_info(self) -> dict:
+        """IP + a free port (rank 0's becomes the jax.distributed
+        coordinator — reference rendezvous: train/torch/config.py:156 via
+        get_address_and_port)."""
+        from ray_tpu.core.rpc import node_ip
+
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        import ray_tpu
+
+        return {"ip": node_ip(), "port": port,
+                "node_id": ray_tpu.get_runtime_context().node_id.hex()}
+
+    def setup_env(self, env: dict) -> bool:
+        os.environ.update({k: str(v) for k, v in env.items()})
+        return True
+
+    def setup_jax(self, coordinator: str | None, num_processes: int,
+                  process_id: int, num_cpu_devices: int | None) -> int:
+        """Configure jax in this process and join the distributed system
+        (reference seam: Backend.on_start — _TorchBackend runs
+        dist.init_process_group here, train/torch/config.py:66-124; the
+        jax-native equivalent is jax.distributed.initialize with rank-0's
+        address)."""
+        if num_cpu_devices:
+            # an inherited --xla_force_host_platform_device_count (e.g.
+            # from a test driver) would override jax_num_cpu_devices
+            flags = os.environ.get("XLA_FLAGS", "")
+            kept = [f for f in flags.split() if
+                    "--xla_force_host_platform_device_count" not in f]
+            os.environ["XLA_FLAGS"] = " ".join(kept)
+        import jax
+
+        if num_cpu_devices:
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", int(num_cpu_devices))
+        if coordinator and num_processes > 1:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        return len(jax.devices())
+
+    # -- training --------------------------------------------------------
+
+    def start_training(self, fn_blob: bytes, train_loop_config: dict | None,
+                       ctx: dict, resume_dir: str | None) -> bool:
+        from ray_tpu.train import session as S
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        fn = cloudpickle.loads(fn_blob)
+        context = S.TrainContext(**ctx)
+        resume = Checkpoint(resume_dir) if resume_dir else None
+        self.session = S.init_session(context, resume)
+
+        def run():
+            try:
+                if train_loop_config is not None:
+                    result = fn(train_loop_config)
+                else:
+                    result = fn()
+                self.session.final = result
+            except BaseException as e:  # noqa: BLE001
+                self.session.error = e
+                self.session.error_tb = traceback.format_exc()
+            finally:
+                self.session.finished.set()
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"train-fn-rank{self.rank}").start()
+        return True
+
+    def next_result(self, timeout: float = 5.0) -> dict:
+        """One report from this worker's session, or a status sentinel.
+        Driven by the driver's result loop (reference:
+        backend_executor.get_next_results :585)."""
+        s = self.session
+        if s is None:
+            return {"status": "idle"}
+        r = s.next_result(timeout=timeout)
+        if r is not None:
+            return {"status": "report", **r}
+        if s.finished.is_set():
+            if s.error is not None:
+                return {"status": "error", "error": repr(s.error),
+                        "traceback": getattr(s, "error_tb", "")}
+            return {"status": "finished", "final": _safe(s.final)}
+        return {"status": "running"}
+
+    def ping(self) -> str:
+        return "pong"
+
+
+def _safe(v):
+    try:
+        cloudpickle.dumps(v)
+        return v
+    except Exception:  # noqa: BLE001
+        return repr(v)
+
+
+class WorkerGroupError(RuntimeError):
+    def __init__(self, msg, rank=None):
+        super().__init__(msg)
+        self.rank = rank
+
+
+class WorkerGroup:
+    """N TrainWorker actors in one placement group."""
+
+    def __init__(self, num_workers: int,
+                 resources_per_worker: dict[str, float] | None = None,
+                 placement_strategy: str = "PACK",
+                 pg_timeout: float = 60.0):
+        import ray_tpu
+        from ray_tpu.util.placement_group import (
+            placement_group,
+            remove_placement_group,
+        )
+
+        self.num_workers = num_workers
+        res = dict(resources_per_worker or {"CPU": 1.0})
+        self._remove_pg = remove_placement_group
+        self.pg = placement_group([dict(res) for _ in range(num_workers)],
+                                  strategy=placement_strategy)
+        if not self.pg.wait(pg_timeout):
+            self._remove_pg(self.pg)
+            raise WorkerGroupError(
+                f"placement group for {num_workers} x {res} not placeable "
+                f"within {pg_timeout}s")
+        cls = ray_tpu.remote(num_cpus=0)(TrainWorker)
+        self.workers = [
+            cls.options(
+                placement_group=self.pg,
+                placement_group_bundle_index=i,
+                max_concurrency=2,  # next_result poll + control calls
+            ).remote(i, num_workers)
+            for i in range(num_workers)
+        ]
+
+    def execute(self, method: str, *args, timeout: float | None = 120.0,
+                **kwargs) -> list:
+        import ray_tpu
+
+        refs = [getattr(w, method).remote(*args, **kwargs)
+                for w in self.workers]
+        return ray_tpu.get(refs, timeout=timeout)
+
+    def execute_single(self, rank: int, method: str, *args,
+                       timeout: float | None = 120.0, **kwargs) -> Any:
+        import ray_tpu
+
+        ref = getattr(self.workers[rank], method).remote(*args, **kwargs)
+        return ray_tpu.get(ref, timeout=timeout)
+
+    def execute_async(self, method: str, *args, **kwargs) -> list:
+        return [getattr(w, method).remote(*args, **kwargs)
+                for w in self.workers]
+
+    def shutdown(self):
+        import ray_tpu
+
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            self._remove_pg(self.pg)
+        except Exception:  # noqa: BLE001
+            pass
+        self.workers = []

@@ -1,0 +1,37 @@
+"""ray_tpu.tune — hyperparameter sweeps over trial actors.
+
+Reference parity: ray.tune (python/ray/tune/) — Tuner.fit over actor
+trials with search spaces, random/grid generation, ASHA early stopping,
+and on-disk experiment state with restore.
+"""
+
+from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.search import (
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.tuner import (
+    ResultGrid,
+    TuneConfig,
+    Tuner,
+    TuneResult,
+    report,
+)
+
+__all__ = [
+    "ASHAScheduler",
+    "FIFOScheduler",
+    "ResultGrid",
+    "TuneConfig",
+    "TuneResult",
+    "Tuner",
+    "choice",
+    "grid_search",
+    "loguniform",
+    "randint",
+    "report",
+    "uniform",
+]

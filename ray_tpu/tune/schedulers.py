@@ -1,0 +1,76 @@
+"""Trial schedulers: FIFO and ASHA early stopping.
+
+Reference parity: ray.tune.schedulers — FIFOScheduler (trial_scheduler.py)
+and ASHAScheduler / AsyncSuccessiveHalving (async_hyperband.py): rungs at
+grace_period * reduction_factor^k; when a trial reaches a rung, it stops
+unless its metric is in the top 1/reduction_factor of results recorded at
+that rung.
+"""
+
+from __future__ import annotations
+
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, result: dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str):
+        pass
+
+
+class ASHAScheduler:
+    def __init__(self, metric: str | None = None, mode: str | None = None,
+                 time_attr: str = "training_iteration",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4, brackets: int = 1):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # milestones: grace, grace*rf, grace*rf^2 ... < max_t
+        self.milestones: list[int] = []
+        m = grace_period
+        while m < max_t:
+            self.milestones.append(m)
+            m *= reduction_factor
+        # rung -> list of recorded metric values
+        self._rungs: dict[int, list[float]] = {m: [] for m in self.milestones}
+        self._trial_progress: dict[str, int] = {}
+
+    def set_objective(self, metric: str, mode: str):
+        self.metric = self.metric or metric
+        self.mode = self.mode or mode
+
+    def on_result(self, trial_id: str, result: dict) -> str:
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        decision = CONTINUE
+        for m in self.milestones:
+            if self._trial_progress.get(trial_id, 0) < m <= t:
+                rung = self._rungs[m]
+                rung.append(float(value))
+                if not self._in_top_fraction(float(value), rung):
+                    decision = STOP
+        self._trial_progress[trial_id] = t
+        return decision
+
+    def _in_top_fraction(self, value: float, rung: list[float]) -> bool:
+        if len(rung) < self.rf:
+            return True  # not enough evidence to cut yet
+        ranked = sorted(rung, reverse=(self.mode == "max"))
+        k = max(1, len(ranked) // self.rf)
+        cutoff = ranked[k - 1]
+        return value >= cutoff if self.mode == "max" else value <= cutoff
+
+    def on_trial_complete(self, trial_id: str):
+        self._trial_progress.pop(trial_id, None)

@@ -1,0 +1,95 @@
+"""Search spaces + trial generation.
+
+Reference parity: ray.tune search-space API (tune/search/sample.py —
+uniform/loguniform/choice/randint, grid_search marker) and the
+BasicVariantGenerator (tune/search/basic_variant.py) that crosses grid
+axes and samples stochastic domains num_samples times.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Float(Domain):
+    def __init__(self, lower: float, upper: float, log: bool = False):
+        self.lower, self.upper, self.log = lower, upper, log
+
+    def sample(self, rng):
+        if self.log:
+            import math
+
+            return math.exp(rng.uniform(math.log(self.lower),
+                                        math.log(self.upper)))
+        return rng.uniform(self.lower, self.upper)
+
+
+class Integer(Domain):
+    def __init__(self, lower: int, upper: int):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.randrange(self.lower, self.upper)
+
+
+class Categorical(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+def uniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper)
+
+
+def loguniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def randint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper)
+
+
+def choice(categories) -> Categorical:
+    return Categorical(categories)
+
+
+def grid_search(values) -> dict:
+    return {"grid_search": list(values)}
+
+
+def _is_grid(v) -> bool:
+    return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
+
+
+def generate_variants(param_space: dict, num_samples: int,
+                      seed: int | None = None) -> list[dict]:
+    """Cross-product of grid axes × num_samples draws of stochastic
+    domains (reference: BasicVariantGenerator semantics — num_samples
+    multiplies the grid)."""
+    rng = random.Random(seed)
+    grid_keys = [k for k, v in param_space.items() if _is_grid(v)]
+    grid_values = [param_space[k]["grid_search"] for k in grid_keys]
+    combos = list(itertools.product(*grid_values)) if grid_keys else [()]
+    variants = []
+    for _ in range(max(1, num_samples)):
+        for combo in combos:
+            cfg = {}
+            for k, v in param_space.items():
+                if k in grid_keys:
+                    cfg[k] = combo[grid_keys.index(k)]
+                elif isinstance(v, Domain):
+                    cfg[k] = v.sample(rng)
+                else:
+                    cfg[k] = v
+            variants.append(cfg)
+    return variants

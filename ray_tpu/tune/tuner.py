@@ -1,0 +1,343 @@
+"""Tuner — experiment driver over trial actors.
+
+Reference parity: ray.tune.Tuner (tune/tuner.py:44, fit :344) driving the
+TuneController event loop (tune/execution/tune_controller.py:68, step
+:666): trials are actors; the controller starts up to the concurrency
+limit, polls reports, consults the scheduler (ASHA early stopping), and
+persists experiment state so `Tuner.restore` can finish interrupted
+sweeps. Trials run as actors on the task/actor runtime — each can itself
+be a JaxTrainer fit (trainer-in-trial, how Train rides Tune in the
+reference, base_trainer.py:577-623)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import queue
+import threading
+import time
+import traceback
+from typing import Any, Callable
+
+import cloudpickle
+
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.tune.search import generate_variants
+
+# ---------------------------------------------------------------- session
+
+_trial_session = None
+
+
+class _TrialSession:
+    def __init__(self):
+        # small bound keeps fast trainables in rough lockstep with the
+        # controller so scheduler decisions (ASHA cuts) apply mid-flight
+        # instead of after the trial already finished
+        self.results: queue.Queue = queue.Queue(maxsize=2)
+        self.iteration = 0
+        self.stopped = threading.Event()
+
+    def report(self, metrics: dict):
+        if self.stopped.is_set():
+            raise _StopTrial()
+        self.iteration += 1
+        m = dict(metrics)
+        m.setdefault("training_iteration", self.iteration)
+        while True:
+            try:
+                self.results.put(m, timeout=0.1)
+                break
+            except queue.Full:
+                if self.stopped.is_set():
+                    raise _StopTrial() from None
+
+
+class _StopTrial(BaseException):
+    """Raised inside the trainable to unwind when the scheduler stops the
+    trial (BaseException so bare `except Exception` in user code doesn't
+    swallow it — reference uses the session's StopIteration channel)."""
+
+
+def report(metrics: dict, **kwargs):
+    """ray_tpu.tune.report — inside a trainable."""
+    if _trial_session is None:
+        raise RuntimeError("tune.report() outside a trial")
+    _trial_session.report(metrics)
+
+
+class TrialActor:
+    """Hosts one trial: runs the trainable on a thread, serves polling."""
+
+    def __init__(self, trial_id: str, fn_blob: bytes, config: dict):
+        global _trial_session
+        self.trial_id = trial_id
+        self.session = _TrialSession()
+        _trial_session = self.session
+        self.error: str | None = None
+        self.finished = threading.Event()
+        fn = cloudpickle.loads(fn_blob)
+
+        def run():
+            try:
+                fn(config)
+            except _StopTrial:
+                pass
+            except BaseException:  # noqa: BLE001
+                self.error = traceback.format_exc()
+            finally:
+                self.finished.set()
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"trial-{trial_id}").start()
+
+    def poll(self, timeout: float = 2.0) -> dict:
+        out = []
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                out.append(self.session.results.get_nowait())
+            except queue.Empty:
+                if out or self.finished.is_set():
+                    break
+                if time.monotonic() >= deadline:
+                    break
+                time.sleep(0.02)
+        done = self.finished.is_set() and self.session.results.empty()
+        return {"results": out, "done": done, "error": self.error}
+
+    def stop(self):
+        self.session.stopped.set()
+        return True
+
+
+# ---------------------------------------------------------------- trials
+
+
+class Trial:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    TERMINATED = "TERMINATED"
+    ERROR = "ERROR"
+    STOPPED = "STOPPED"  # by scheduler
+
+    def __init__(self, trial_id: str, config: dict):
+        self.trial_id = trial_id
+        self.config = config
+        self.status = Trial.PENDING
+        self.last_result: dict = {}
+        self.error: str | None = None
+        self.actor = None
+
+    def to_json(self) -> dict:
+        return {"trial_id": self.trial_id, "config": _json_safe(self.config),
+                "status": self.status, "last_result": _json_safe(self.last_result),
+                "error": self.error}
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    """Reference: ray.tune.TuneConfig."""
+
+    metric: str | None = None
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: int | None = None
+    scheduler: Any = None
+    seed: int | None = None
+    trial_resources: dict[str, float] | None = None
+
+
+@dataclasses.dataclass
+class TuneResult:
+    trial_id: str
+    config: dict
+    metrics: dict
+    error: str | None = None
+
+
+class ResultGrid:
+    """Reference: ray.tune.ResultGrid."""
+
+    def __init__(self, results: list[TuneResult], metric, mode):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i):
+        return self._results[i]
+
+    @property
+    def errors(self):
+        return [r for r in self._results if r.error]
+
+    def get_best_result(self, metric: str | None = None,
+                        mode: str | None = None) -> TuneResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        scored = [r for r in self._results
+                  if r.error is None and metric in r.metrics]
+        if not scored:
+            raise ValueError("no successful trial reported "
+                             f"metric {metric!r}")
+        return (max if mode == "max" else min)(
+            scored, key=lambda r: r.metrics[metric])
+
+    def get_dataframe(self):
+        rows = [{"trial_id": r.trial_id, **r.metrics,
+                 **{f"config/{k}": v for k, v in r.config.items()}}
+                for r in self._results]
+        try:
+            import pandas as pd
+
+            return pd.DataFrame(rows)
+        except ImportError:
+            return rows
+
+
+# ---------------------------------------------------------------- tuner
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *, param_space: dict | None = None,
+                 tune_config: TuneConfig | None = None,
+                 run_config=None):
+        from ray_tpu.train.trainer import RunConfig
+
+        self._trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self._restored_trials: list[Trial] | None = None
+
+    # -- persistence -----------------------------------------------------
+
+    def _exp_dir(self) -> str:
+        name = self.run_config.name or "tune_experiment"
+        storage = self.run_config.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results")
+        return os.path.join(storage, name)
+
+    def _save_state(self, trials: list[Trial]):
+        d = self._exp_dir()
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, ".tuner_state.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump({"trials": [t.to_json() for t in trials]}, f)
+        os.replace(tmp, os.path.join(d, "tuner_state.json"))
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable) -> "Tuner":
+        """Resume an interrupted experiment: finished trials keep their
+        recorded results, unfinished ones run again (reference:
+        Tuner.restore, tune/tuner.py)."""
+        with open(os.path.join(path, "tuner_state.json")) as f:
+            state = json.load(f)
+        tuner = cls(trainable)
+        tuner.run_config.name = os.path.basename(path.rstrip("/"))
+        tuner.run_config.storage_path = os.path.dirname(path.rstrip("/"))
+        trials = []
+        for tj in state["trials"]:
+            t = Trial(tj["trial_id"], tj["config"])
+            t.status = tj["status"]
+            t.last_result = tj["last_result"]
+            t.error = tj.get("error")
+            if t.status in (Trial.PENDING, Trial.RUNNING):
+                t.status = Trial.PENDING  # rerun interrupted trials
+            trials.append(t)
+        tuner._restored_trials = trials
+        return tuner
+
+    # -- fit -------------------------------------------------------------
+
+    def fit(self) -> ResultGrid:
+        import ray_tpu
+
+        tc = self.tune_config
+        scheduler = tc.scheduler or FIFOScheduler()
+        if hasattr(scheduler, "set_objective") and tc.metric:
+            scheduler.set_objective(tc.metric, tc.mode)
+        if self._restored_trials is not None:
+            trials = self._restored_trials
+        else:
+            variants = generate_variants(self.param_space, tc.num_samples,
+                                         tc.seed)
+            trials = [Trial(f"trial_{i:05d}", cfg)
+                      for i, cfg in enumerate(variants)]
+        fn_blob = cloudpickle.dumps(self._trainable)
+        res = dict(tc.trial_resources or {"CPU": 1.0})
+        limit = tc.max_concurrent_trials or max(
+            1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+        actor_cls = ray_tpu.remote(**{
+            "num_cpus": res.get("CPU", 1.0),
+            "resources": {k: v for k, v in res.items() if k != "CPU"},
+        })(TrialActor)
+
+        pending = [t for t in trials if t.status == Trial.PENDING]
+        running: list[Trial] = []
+        self._save_state(trials)
+        while pending or running:
+            while pending and len(running) < limit:
+                t = pending.pop(0)
+                t.actor = actor_cls.options(
+                    max_concurrency=2).remote(t.trial_id, fn_blob, t.config)
+                t.status = Trial.RUNNING
+                running.append(t)
+            refs = {t.trial_id: t.actor.poll.remote() for t in running}
+            for t in list(running):
+                try:
+                    r = ray_tpu.get(refs[t.trial_id], timeout=120)
+                except Exception as e:  # noqa: BLE001
+                    t.status = Trial.ERROR
+                    t.error = f"trial actor failed: {e}"
+                    running.remove(t)
+                    scheduler.on_trial_complete(t.trial_id)
+                    continue
+                decision = CONTINUE
+                for m in r["results"]:
+                    t.last_result = m
+                    if scheduler.on_result(t.trial_id, m) == STOP:
+                        decision = STOP
+                if r["error"]:
+                    t.status = Trial.ERROR
+                    t.error = r["error"]
+                elif r["done"]:
+                    t.status = Trial.TERMINATED
+                elif decision == STOP:
+                    t.status = Trial.STOPPED
+                    try:
+                        ray_tpu.get(t.actor.stop.remote(), timeout=30)
+                    except Exception:  # noqa: BLE001
+                        pass
+                if t.status != Trial.RUNNING:
+                    # always reap the actor: a terminated trial's worker
+                    # process would otherwise keep holding its resources
+                    try:
+                        ray_tpu.kill(t.actor)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    t.actor = None
+                    running.remove(t)
+                    scheduler.on_trial_complete(t.trial_id)
+                    self._save_state(trials)
+            time.sleep(0.02)
+        self._save_state(trials)
+        results = [TuneResult(t.trial_id, t.config, t.last_result, t.error)
+                   for t in trials]
+        return ResultGrid(results, tc.metric, tc.mode)
+
+
+def _json_safe(d: dict) -> dict:
+    out = {}
+    for k, v in d.items():
+        try:
+            json.dumps(v)
+            out[k] = v
+        except (TypeError, ValueError):
+            out[k] = repr(v)
+    return out
+

@@ -1,0 +1,194 @@
+"""Metrics: counters/gauges/histograms + Prometheus text exposition.
+
+Reference parity: the user metrics API (python/ray/util/metrics.py:137-262
+— Counter/Gauge/Histogram with tag_keys) over a per-process registry
+(C++ reference: src/ray/stats/metric.h:103), exported in Prometheus text
+format (reference: _private/prometheus_exporter.py). Core runtime
+components register their own metrics into the same registry."""
+
+from __future__ import annotations
+
+import threading
+from typing import Sequence
+
+
+class _Registry:
+    def __init__(self):
+        self._metrics: dict[str, "Metric"] = {}
+        self._lock = threading.Lock()
+
+    def register(self, m: "Metric"):
+        with self._lock:
+            existing = self._metrics.get(m.name)
+            if existing is not None:
+                return existing
+            self._metrics[m.name] = m
+            return m
+
+    def collect(self) -> list["Metric"]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def clear(self):
+        with self._lock:
+            self._metrics.clear()
+
+
+_registry = _Registry()
+
+
+def _fmt_tags(tags: dict | None) -> str:
+    if not tags:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
+    return "{" + inner + "}"
+
+
+class Metric:
+    TYPE = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+        registered = _registry.register(self)
+        if registered is not self:
+            # same-name re-creation shares state (reference behavior)
+            self._values = registered._values
+            self._lock = registered._lock
+
+    def _key(self, tags: dict | None) -> tuple:
+        tags = tags or {}
+        return tuple(tags.get(k, "") for k in self.tag_keys)
+
+    def _tags_of(self, key: tuple) -> dict:
+        return dict(zip(self.tag_keys, key))
+
+    def expose(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.description}",
+                 f"# TYPE {self.name} {self.TYPE}"]
+        with self._lock:
+            items = list(self._values.items())
+        if not items:
+            lines.append(f"{self.name} 0")
+        for key, v in items:
+            lines.append(f"{self.name}{_fmt_tags(self._tags_of(key))} {v}")
+        return lines
+
+
+class Counter(Metric):
+    TYPE = "counter"
+
+    def inc(self, value: float = 1.0, tags: dict | None = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        k = self._key(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+
+class Gauge(Metric):
+    TYPE = "gauge"
+
+    def set(self, value: float, tags: dict | None = None):
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+
+    def inc(self, value: float = 1.0, tags: dict | None = None):
+        k = self._key(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def dec(self, value: float = 1.0, tags: dict | None = None):
+        self.inc(-value, tags)
+
+
+class Histogram(Metric):
+    TYPE = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = (), tag_keys: Sequence[str] = ()):
+        self.boundaries = tuple(boundaries) or (
+            0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10)
+        super().__init__(name, description, tag_keys)
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+
+    def observe(self, value: float, tags: dict | None = None):
+        k = self._key(tags)
+        with self._lock:
+            counts = self._counts.setdefault(
+                k, [0] * (len(self.boundaries) + 1))
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._totals[k] = self._totals.get(k, 0) + 1
+
+    def expose(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.description}",
+                 f"# TYPE {self.name} histogram"]
+        with self._lock:
+            keys = list(self._counts)
+            for k in keys:
+                tags = self._tags_of(k)
+                cum = 0
+                for i, b in enumerate(self.boundaries):
+                    cum += self._counts[k][i]
+                    t = dict(tags, le=str(b))
+                    lines.append(f"{self.name}_bucket{_fmt_tags(t)} {cum}")
+                cum += self._counts[k][-1]
+                t = dict(tags, le="+Inf")
+                lines.append(f"{self.name}_bucket{_fmt_tags(t)} {cum}")
+                lines.append(
+                    f"{self.name}_sum{_fmt_tags(tags)} {self._sums[k]}")
+                lines.append(
+                    f"{self.name}_count{_fmt_tags(tags)} {self._totals[k]}")
+        return lines
+
+
+def prometheus_text() -> str:
+    """This process's metrics in Prometheus exposition format."""
+    lines: list[str] = []
+    for m in _registry.collect():
+        lines.extend(m.expose())
+    return "\n".join(lines) + "\n"
+
+
+def clear_registry():
+    _registry.clear()
+
+
+def serve_metrics_http(port: int = 0) -> int:
+    """Expose /metrics over HTTP (reference: metrics agent endpoint).
+    Returns the bound port."""
+    import http.server
+    import threading as _t
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path not in ("/metrics", "/"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    _t.Thread(target=server.serve_forever, daemon=True,
+              name="metrics-http").start()
+    return server.server_address[1]

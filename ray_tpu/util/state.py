@@ -1,0 +1,68 @@
+"""State API — programmatic cluster introspection.
+
+Reference parity: ray.util.state (python/ray/util/state/api.py —
+list_actors/list_nodes/list_placement_groups; task events feed `ray list
+tasks` in the reference; here per-process task events are exported via
+ray_tpu.timeline())."""
+
+from __future__ import annotations
+
+
+def _head_call(method: str, msg: dict | None = None,
+               address: str | None = None):
+    from ray_tpu.core.rpc import RpcClient
+
+    if address is None:
+        from ray_tpu.core import api as _api
+
+        rt = _api._runtime
+        if rt is None or not hasattr(rt, "head_address"):
+            raise RuntimeError("state API needs ray_tpu.init() or an "
+                               "explicit head address")
+        address = rt.head_address
+    return RpcClient.shared().call(address, method, msg or {}, timeout=30)
+
+
+def list_actors(address: str | None = None) -> list[dict]:
+    return _head_call("list_actors", address=address)["actors"]
+
+
+def list_nodes(address: str | None = None) -> list[dict]:
+    view = _head_call("cluster_view", address=address)
+    return [
+        {
+            "node_id": n["node_id"].hex(),
+            "address": n["address"],
+            "alive": n["alive"],
+            "resources": n["resources"],
+            "available": n["available"],
+            "labels": n["labels"],
+        }
+        for n in view["nodes"]
+    ]
+
+
+def list_placement_groups(address: str | None = None) -> list[dict]:
+    return _head_call("pg_table", address=address).get("groups", [])
+
+
+def summarize(address: str | None = None) -> dict:
+    nodes = list_nodes(address)
+    actors = list_actors(address)
+    total: dict[str, float] = {}
+    avail: dict[str, float] = {}
+    for n in nodes:
+        if not n["alive"]:
+            continue
+        for r, q in n["resources"].items():
+            total[r] = total.get(r, 0.0) + q
+        for r, q in n["available"].items():
+            avail[r] = avail.get(r, 0.0) + q
+    return {
+        "nodes_alive": sum(1 for n in nodes if n["alive"]),
+        "nodes_dead": sum(1 for n in nodes if not n["alive"]),
+        "actors_alive": sum(1 for a in actors if a["state"] == "ALIVE"),
+        "actors_total": len(actors),
+        "resources_total": total,
+        "resources_available": avail,
+    }

@@ -126,10 +126,81 @@ def test_pg_infeasible_stays_pending(cluster):
     assert not pg.wait(2)
     info = placement_group_table(pg)
     assert info["state"] == "PENDING"
+    remove_placement_group(pg)
+
+
+def test_pg_pending_created_after_node_add(cluster):
+    """VERDICT done-criterion: infeasible PG becomes CREATED when a
+    feasible node joins (head-side pending replanning — reference:
+    gcs_placement_group_manager pending queue)."""
+    c = cluster
+    pg2 = placement_group([{"bigres": 1}], strategy="PACK")
+    assert not pg2.wait(1.5)
+    extra = c.add_node(num_cpus=2, resources={"bigres": 2.0})
+    assert pg2.wait(20)
+    info = placement_group_table(pg2)
+    assert info["state"] == "CREATED"
+    remove_placement_group(pg2)
+    c.remove_node(extra)
+    # don't leak a mid-death node into the next test's resource snapshots
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if sum(1 for n in ray_tpu.nodes() if n["Alive"]) == 3:
+            break
+        time.sleep(0.3)
+    assert sum(1 for n in ray_tpu.nodes() if n["Alive"]) == 3
+
+
+def test_pg_bundle_metering_serializes_tasks(cluster):
+    """Tasks inside a PG cannot exceed the bundle reservation: two 1-CPU
+    tasks against a 1-CPU bundle must run one after the other."""
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(30)
+
+    @ray_tpu.remote(
+        num_cpus=1,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0))
+    def stamp():
+        import time as _t
+
+        start = _t.monotonic()
+        _t.sleep(0.5)
+        return (start, _t.monotonic())
+
+    spans = ray_tpu.get([stamp.remote(), stamp.remote()], timeout=90)
+    (s0, e0), (s1, e1) = sorted(spans)
+    assert s1 >= e0 - 0.05, f"overlapping spans: {spans}"
+    remove_placement_group(pg)
+
+
+def test_pg_bundle_rejects_oversized_task(cluster):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(30)
+
+    @ray_tpu.remote(
+        num_cpus=2,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0))
+    def too_big():
+        return "ran"
+
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(too_big.remote(), timeout=60)
+    assert "bundle" in str(ei.value)
+    remove_placement_group(pg)
 
 
 def test_pg_releases_resources_on_remove(cluster):
+    # let releases from earlier tests settle so the snapshots are stable
     before = ray_tpu.available_resources().get("CPU", 0)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        time.sleep(1.0)
+        now = ray_tpu.available_resources().get("CPU", 0)
+        if now == before:
+            break
+        before = now
     pg = placement_group([{"CPU": 2}], strategy="PACK")
     assert pg.wait(30)
     time.sleep(1.2)  # heartbeat propagation

@@ -1,0 +1,101 @@
+"""Metrics registry, state API, CLI (reference model:
+python/ray/tests/test_metrics_agent.py + util/state tests)."""
+
+import json
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import metrics as m
+from ray_tpu.util import state
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    m.clear_registry()
+    yield
+    m.clear_registry()
+
+
+def test_counter_gauge_exposition():
+    c = m.Counter("reqs_total", "requests", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2, tags={"route": "/b"})
+    g = m.Gauge("queue_depth", "depth")
+    g.set(7)
+    text = m.prometheus_text()
+    assert 'reqs_total{route="/a"} 1.0' in text
+    assert 'reqs_total{route="/b"} 2.0' in text
+    assert "queue_depth 7.0" in text
+    assert "# TYPE reqs_total counter" in text
+
+
+def test_histogram_buckets():
+    h = m.Histogram("lat_s", "latency", boundaries=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    text = m.prometheus_text()
+    assert 'lat_s_bucket{le="0.1"} 1' in text
+    assert 'lat_s_bucket{le="1.0"} 2' in text
+    assert 'lat_s_bucket{le="+Inf"} 3' in text
+    assert "lat_s_count 3" in text
+
+
+def test_metrics_http_endpoint():
+    m.Counter("hits", "h").inc(3)
+    port = m.serve_metrics_http(0)
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=10) as r:
+        body = r.read().decode()
+    assert "hits 3.0" in body
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_state_api(cluster):
+    @ray_tpu.remote
+    class Marker:
+        def ping(self):
+            return "ok"
+
+    a = Marker.options(name="state_marker").remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "ok"
+    actors = state.list_actors()
+    assert any(x["state"] == "ALIVE" for x in actors)
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+    s = state.summarize()
+    assert s["nodes_alive"] == 1
+    assert s["actors_alive"] >= 1
+
+
+def test_cli_status_and_list(cluster):
+    addr = cluster.address
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "status",
+         "--address", addr],
+        capture_output=True, text=True, timeout=60, cwd="/root/repo",
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr
+    assert "nodes: 1 alive" in out.stdout
+
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "list", "nodes",
+         "--address", addr],
+        capture_output=True, text=True, timeout=60, cwd="/root/repo",
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr
+    rows = json.loads(out.stdout)
+    assert rows and rows[0]["alive"]

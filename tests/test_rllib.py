@@ -1,0 +1,94 @@
+"""RLlib slice tests (reference model: rllib/algorithms/ppo/tests/
+test_ppo.py — short real training runs on CartPole asserting learning).
+"""
+
+import sys
+
+import cloudpickle
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.rllib import PPOConfig
+from ray_tpu.rllib.learner import compute_gae
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 8})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_gae_matches_bruteforce():
+    T, N = 5, 2
+    rng = np.random.RandomState(0)
+    rewards = rng.rand(T, N).astype(np.float32)
+    values = rng.rand(T, N).astype(np.float32)
+    dones = np.zeros((T, N), bool)
+    dones[2, 0] = True
+    last = rng.rand(N).astype(np.float32)
+    gamma, lam = 0.9, 0.8
+    adv, tgt = compute_gae(rewards, values, dones, last, gamma, lam)
+
+    # brute force per env
+    for n in range(N):
+        vals = np.append(values[:, n], last[n])
+        expected = np.zeros(T)
+        gae = 0.0
+        for t in range(T - 1, -1, -1):
+            nonterm = 0.0 if dones[t, n] else 1.0
+            delta = rewards[t, n] + gamma * vals[t + 1] * nonterm - vals[t]
+            gae = delta + gamma * lam * nonterm * gae
+            expected[t] = gae
+        np.testing.assert_allclose(adv[:, n], expected, rtol=1e-5)
+    np.testing.assert_allclose(tgt, adv + values, rtol=1e-6)
+
+
+def test_ppo_learns_cartpole_inline():
+    """Learner + sampling logic sanity without the cluster (fast)."""
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=16,
+                         rollout_fragment_length=128)
+            .training(num_sgd_iter=6, minibatch_size=256)).build()
+    best = 0.0
+    for _ in range(40):
+        r = algo.train()
+        if r["episode_return_mean"] == r["episode_return_mean"]:  # not nan
+            best = max(best, r["episode_return_mean"])
+        if best >= 195:
+            break
+    assert best >= 195, f"PPO failed to learn CartPole (best {best})"
+
+
+def test_ppo_distributed_env_runners(cluster):
+    """The VERDICT done-criterion: PPO on CartPole THROUGH the runtime —
+    env-runner actors sampling remotely, weight sync via the object
+    store, reward >= 195 in < 5 min."""
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                         rollout_fragment_length=128)
+            .training(num_sgd_iter=6, minibatch_size=256)).build()
+    import time
+
+    t0 = time.time()
+    best = 0.0
+    steps_per_sec = []
+    while time.time() - t0 < 300:
+        r = algo.train()
+        steps_per_sec.append(r["env_steps_per_sec"])
+        if r["episode_return_mean"] == r["episode_return_mean"]:
+            best = max(best, r["episode_return_mean"])
+        if best >= 195:
+            break
+    algo.stop()
+    assert best >= 195, f"PPO (distributed) failed to learn (best {best})"
+    assert max(steps_per_sec) > 100  # sanity: sampling actually parallel

@@ -1,0 +1,106 @@
+"""Serve tests (reference model: serve/tests — deploy, route, scale,
+HTTP ingress)."""
+
+import json
+import sys
+import urllib.request
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.cluster_utils import Cluster
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 8})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    serve.shutdown()
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_deploy_and_call(cluster):
+    @serve.deployment(num_replicas=2)
+    class Doubler:
+        def __init__(self, bias):
+            self.bias = bias
+
+        def __call__(self, x):
+            return 2 * x + self.bias
+
+    handle = serve.run(Doubler.bind(5), name="doubler")
+    results = ray_tpu.get([handle.remote(i) for i in range(10)], timeout=60)
+    assert results == [2 * i + 5 for i in range(10)]
+    serve.delete("doubler")
+
+
+def test_function_deployment(cluster):
+    @serve.deployment
+    def greeter(name):
+        return f"hello {name}"
+
+    handle = serve.run(greeter.bind(), name="greet")
+    assert ray_tpu.get(handle.remote("tpu"), timeout=60) == "hello tpu"
+    serve.delete("greet")
+
+
+def test_replicas_share_load(cluster):
+    @serve.deployment(num_replicas=2)
+    class WhoAmI:
+        def __init__(self):
+            import os
+
+            self.pid = os.getpid()
+
+        def __call__(self, _):
+            return self.pid
+
+    handle = serve.run(WhoAmI.bind(), name="who")
+    pids = set(ray_tpu.get([handle.remote(None) for _ in range(20)],
+                           timeout=60))
+    assert len(pids) == 2  # both replicas served traffic
+    serve.delete("who")
+
+
+def test_method_routing_and_handle_reacquire(cluster):
+    @serve.deployment(num_replicas=1)
+    class Store:
+        def __init__(self):
+            self.v = {}
+
+        def put(self, k, val):
+            self.v[k] = val
+            return "ok"
+
+        def get(self, k):
+            return self.v.get(k)
+
+    serve.run(Store.bind(), name="store")
+    handle = serve.get_app_handle("store")
+    assert ray_tpu.get(handle.method("put")("a", 1), timeout=60) == "ok"
+    assert ray_tpu.get(handle.method("get")("a"), timeout=60) == 1
+    serve.delete("store")
+
+
+def test_http_ingress(cluster):
+    @serve.deployment
+    class Echo:
+        def __call__(self, payload):
+            return {"echo": payload}
+
+    serve.run(Echo.bind(), name="echo", http_port=18123)
+    req = urllib.request.Request(
+        "http://127.0.0.1:18123/echo",
+        data=json.dumps({"msg": "hi"}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        body = json.loads(resp.read())
+    assert body["result"]["echo"] == {"msg": "hi"}
+    serve.delete("echo")

@@ -1,0 +1,256 @@
+"""JaxTrainer / WorkerGroup / checkpoint tests.
+
+Reference model: train/tests (BackendExecutor + WorkerGroup tests) and
+the v2 controller restart tests. Multi-worker runs use jax processes on
+the CPU backend with virtual devices — the same rendezvous path a TPU
+pod slice uses, minus the hardware."""
+
+import os
+import sys
+
+import cloudpickle
+import numpy as np
+import pytest
+
+import ray_tpu
+
+# train-loop functions below are module-level in a non-importable test
+# module; ship them by value (reference equivalent: runtime_env
+# working_dir makes the module importable on workers)
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.train import (
+    Checkpoint,
+    CheckpointConfig,
+    CheckpointManager,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+    TrainingFailedError,
+)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 8})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+# ---------------------------------------------------------------- manager
+
+
+def test_checkpoint_manager_topk(tmp_path):
+    mgr = CheckpointManager(
+        str(tmp_path / "exp"),
+        CheckpointConfig(num_to_keep=2, checkpoint_score_attribute="acc"))
+    paths = []
+    for i, acc in enumerate([0.1, 0.9, 0.5, 0.3]):
+        src = tmp_path / f"ck{i}"
+        src.mkdir()
+        (src / "model.txt").write_text(str(i))
+        ck = mgr.register(Checkpoint(str(src)), {"acc": acc})
+        paths.append(ck.path)
+    kept = sorted(os.listdir(tmp_path / "exp"))
+    # top-2 by acc = (0.9, 0.5) plus the most recent (0.3) is never deleted
+    assert len(kept) == 3
+    assert mgr.best() is not None
+    with open(os.path.join(mgr.best().path, "model.txt")) as f:
+        assert f.read() == "1"  # acc=0.9 was checkpoint index 1
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "w.npy").write_bytes(b"abc")
+    ck = Checkpoint.from_directory(str(src))
+    dest = ck.to_directory(str(tmp_path / "dst"))
+    assert (tmp_path / "dst" / "w.npy").read_bytes() == b"abc"
+    with ck.as_directory() as d:
+        assert os.path.exists(os.path.join(d, "w.npy"))
+    assert dest
+
+
+# ---------------------------------------------------------------- trainer
+
+
+def _simple_loop(config):
+    import ray_tpu.train as train
+
+    ctx = train.get_context()
+    for step in range(config["steps"]):
+        train.report({"step": step, "rank": ctx.get_world_rank(),
+                      "world": ctx.get_world_size()})
+    return "done"
+
+
+def test_single_worker_reports(cluster, tmp_path):
+    trainer = JaxTrainer(
+        _simple_loop,
+        train_loop_config={"steps": 3},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t1", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.metrics["step"] == 2
+    assert len(result.metrics_history) == 3
+    assert result.metrics["world"] == 1
+
+
+def test_two_workers_rank_env(cluster, tmp_path):
+    def loop(config):
+        import os
+
+        import ray_tpu.train as train
+
+        ctx = train.get_context()
+        train.report({
+            "rank": ctx.get_world_rank(),
+            "env_rank": int(os.environ["RAY_TPU_TRAIN_RANK"]),
+            "world": int(os.environ["RAY_TPU_TRAIN_WORLD_SIZE"]),
+        })
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="t2", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.metrics["rank"] == 0
+    assert result.metrics["env_rank"] == 0
+    assert result.metrics["world"] == 2
+
+
+def _gpt2_loop(config):
+    """GPT-2-tiny over however many jax processes the gang has."""
+    import jax
+    import numpy as np
+    import optax
+
+    import ray_tpu.train as train
+    from ray_tpu.models.gpt2 import (
+        GPT2Config,
+        gpt2_loss,
+        gpt2_partition_rules,
+        init_gpt2,
+    )
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+    from ray_tpu.train import checkpointing
+    from ray_tpu.train.spmd import (
+        batch_shardings,
+        init_sharded_state,
+        make_train_step,
+    )
+
+    ctx = train.get_context()
+    cfg = GPT2Config.tiny()
+    mesh = build_mesh(MeshSpec(data=-1), devices=jax.devices())
+    tx = optax.adamw(1e-3)
+    state = init_sharded_state(
+        lambda: init_gpt2(jax.random.PRNGKey(0), cfg), tx, mesh,
+        gpt2_partition_rules())
+
+    start_step = 0
+    ck = train.get_checkpoint()
+    if ck is not None:
+        with ck.as_directory() as d:
+            state = checkpointing.load_train_state(d, state)
+        start_step = int(np.asarray(state.step))
+
+    # deterministic GLOBAL batch, identical regardless of world layout
+    B, T = 8, cfg.block_size
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, cfg.vocab_size, (B, T + 1)).astype(np.int32)
+    global_batch = {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+    sh = batch_shardings(mesh, global_batch)
+    per = B // jax.process_count()
+    lo = jax.process_index() * per
+    batch = jax.tree.map(
+        lambda arr, s: jax.make_array_from_process_local_data(
+            s, arr[lo:lo + per], arr.shape),
+        global_batch, sh)
+
+    step_fn = make_train_step(lambda p, b: gpt2_loss(p, b, cfg), tx)
+    with mesh:
+        for step in range(start_step, config["steps"]):
+            if config.get("crash_at") == step and ctx.get_world_rank() == 0 \
+                    and train.get_checkpoint() is None:
+                import os
+
+                os._exit(1)  # simulate a host loss mid-run (first try only)
+            state, metrics = step_fn(state, batch)
+            loss = float(np.asarray(metrics["loss"]))
+            ckpt = None
+            do_ckpt = (step + 1) % config.get("ckpt_every", 10 ** 9) == 0 \
+                or step == config["steps"] - 1
+            if do_ckpt:
+                # collective save: EVERY process calls in; rank 0 reports
+                tmp = f"{ctx.get_trial_dir()}/pending_ckpt_{step}"
+                checkpointing.save_train_state(state, tmp)
+                if ctx.get_world_rank() == 0:
+                    ckpt = train.Checkpoint(tmp)
+            train.report({"loss": loss, "step": step}, checkpoint=ckpt)
+
+
+def test_gpt2_loss_parity_1_vs_2_workers(cluster, tmp_path):
+    """Same global batch + init => identical loss whether the mesh spans
+    one process or two (the SPMD-equivalence guarantee DDP tests assert
+    via allreduce parity)."""
+    losses = {}
+    for n_workers, devs in ((1, 8), (2, 4)):
+        trainer = JaxTrainer(
+            _gpt2_loop,
+            train_loop_config={"steps": 3},
+            scaling_config=ScalingConfig(
+                num_workers=n_workers,
+                num_cpu_devices_per_worker=devs),
+            run_config=RunConfig(name=f"parity{n_workers}",
+                                 storage_path=str(tmp_path)),
+        )
+        result = trainer.fit()
+        losses[n_workers] = [m["loss"] for m in result.metrics_history]
+    assert len(losses[1]) == len(losses[2]) == 3
+    np.testing.assert_allclose(losses[1], losses[2], rtol=1e-4, atol=1e-5)
+
+
+def test_gang_restart_resumes_from_checkpoint(cluster, tmp_path):
+    """Kill rank 0 mid-run; the gang restarts from the latest checkpoint
+    and the loss curve continues (VERDICT r1 done-criterion)."""
+    trainer = JaxTrainer(
+        _gpt2_loop,
+        train_loop_config={"steps": 6, "ckpt_every": 2, "crash_at": 4},
+        scaling_config=ScalingConfig(num_workers=1,
+                                     num_cpu_devices_per_worker=2),
+        run_config=RunConfig(
+            name="restart", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1),
+            checkpoint_config=CheckpointConfig(num_to_keep=2)),
+    )
+    result = trainer.fit()
+    steps = [m["step"] for m in result.metrics_history]
+    # crashed at step 4 (before reporting), resumed from ckpt@step 3
+    assert steps[-1] == 5
+    assert 4 in steps
+    assert result.checkpoint is not None
+    losses = [m["loss"] for m in result.metrics_history]
+    assert losses[-1] < losses[0]
+
+
+def test_failure_budget_exhausted(cluster, tmp_path):
+    def always_fail(config):
+        raise RuntimeError("boom")
+
+    trainer = JaxTrainer(
+        always_fail,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="fail", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=1)),
+    )
+    with pytest.raises(TrainingFailedError):
+        trainer.fit()

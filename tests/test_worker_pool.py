@@ -1,0 +1,69 @@
+"""Worker pool cap / reuse / prestart (reference model: WorkerPool,
+raylet/worker_pool.h:216 — caps by cores, reuses idle workers)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def capped_cluster(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_MAX_WORKERS", "3")
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 8})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+    monkeypatch.delenv("RAY_TPU_MAX_WORKERS", raising=False)
+
+
+def test_burst_respects_pool_cap(capped_cluster):
+    """A 60-task burst must not fork 60 interpreters: the pool is capped
+    (here at 3) and workers are reused."""
+    nl = capped_cluster.nodelets[0]
+
+    @ray_tpu.remote(num_cpus=0.1)
+    def work(i):
+        return os.getpid()
+
+    refs = [work.remote(i) for i in range(60)]
+    pids = set(ray_tpu.get(refs, timeout=120))
+    assert len(pids) <= 3, f"{len(pids)} distinct workers for a capped pool"
+    with nl._lock:
+        n_task_workers = sum(1 for w in nl._workers.values()
+                             if w.actor_id is None)
+    assert n_task_workers <= 3
+
+
+def test_workers_reused_across_tasks(capped_cluster):
+    @ray_tpu.remote(num_cpus=0.1)
+    def pid():
+        return os.getpid()
+
+    first = ray_tpu.get([pid.remote() for _ in range(3)], timeout=60)
+    second = ray_tpu.get([pid.remote() for _ in range(3)], timeout=60)
+    assert set(first) & set(second), "idle workers were not reused"
+
+
+def test_prestart_workers(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_PRESTART_WORKERS", "2")
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    c.wait_for_nodes()
+    try:
+        nl = c.nodelets[0]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with nl._lock:
+                if len(nl._idle_workers) >= 2:
+                    break
+            time.sleep(0.2)
+        with nl._lock:
+            assert len(nl._idle_workers) >= 2
+    finally:
+        c.shutdown()
+        monkeypatch.delenv("RAY_TPU_PRESTART_WORKERS", raising=False)
