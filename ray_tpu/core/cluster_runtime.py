@@ -96,6 +96,7 @@ class ClusterRuntime:
         self._task_actor: dict[bytes, bytes] = {}  # task_id -> actor_id
         # objects we borrow (store bytes owned elsewhere): oid -> owner
         self._borrowed_owner: dict[bytes, str] = {}
+        self._rtenv_cache: dict = {}  # normalized runtime envs by content
         # Store buffers pinned because a deserialized object graph aliases
         # them zero-copy (plasma pin semantics); released when the owning
         # object is freed or at shutdown.
@@ -273,16 +274,27 @@ class ClusterRuntime:
         with self._lock:
             st = self._owned.get(b)
         if st is not None:
-            if not st.event.wait(self._remaining(deadline)):
-                raise exc.GetTimeoutError(f"get() timed out waiting for {ref}")
-            if st.error is not None:
-                raise st.error
-            if st.has_cached:
-                return st.value_cached
-            value = self._materialize(b, st.inline, st.location, st.store_name)
-            st.value_cached = value
-            st.has_cached = True
-            return value
+            while True:
+                if not st.event.wait(self._remaining(deadline)):
+                    raise exc.GetTimeoutError(
+                        f"get() timed out waiting for {ref}")
+                if st.error is not None:
+                    raise st.error
+                if st.has_cached:
+                    return st.value_cached
+                try:
+                    value = self._materialize(b, st.inline, st.location,
+                                              st.store_name)
+                except exc.ObjectLostError:
+                    # lineage reconstruction: re-execute the producing
+                    # task (reference: ObjectRecoveryManager,
+                    # core_worker/object_recovery_manager.h:38)
+                    if not self._try_reconstruct(st):
+                        raise
+                    continue
+                st.value_cached = value
+                st.has_cached = True
+                return value
         # borrowed: ask the owner
         owner = ref.owner
         if owner is None or owner == self.address:
@@ -316,6 +328,49 @@ class ClusterRuntime:
                                          value.get("store_name"))
             raise exc.ObjectLostError(f"{ref}: owner reports {status}")
 
+    def _try_reconstruct(self, st: "_Owned") -> bool:
+        """Resubmit the task whose output was lost (its spec is the
+        lineage). Consumes the task's retry budget; `put()` objects have
+        no lineage and are not recoverable — same as the reference."""
+        spec = st.spec
+        if spec is None or not self.nodelet_address:
+            return False
+        with self._lock:
+            states = [self._owned.get(b) for b in spec.return_oids]
+            st0 = states[0] if states else None
+            if st0 is None or st0.cancelled:
+                return False
+            if not st0.event.is_set():
+                # another getter already kicked off reconstruction of
+                # this task: just go back to waiting on the event
+                return True
+            if st0.retries_left <= 0:
+                return False
+            for s in states:
+                if s is None:
+                    continue
+                s.retries_left -= 1
+                s.event.clear()
+                s.inline = None
+                s.location = None
+                s.store_name = None
+                s.value_cached = None
+                s.has_cached = False
+            spec.attempt += 1
+            spec.spillback_count = 0
+        try:
+            self.client.call(self.nodelet_address, "schedule_task",
+                             {"spec": dataclass_dict(spec)}, timeout=30,
+                             retries=2)
+        except Exception:
+            for s in states:
+                if s is not None and not s.event.is_set():
+                    s.error = exc.ObjectLostError(
+                        "reconstruction submission failed")
+                    s.event.set()
+            return False
+        return True
+
     def _materialize(self, oid: bytes, inline, location, store_name):
         if inline is not None:
             return ser.deserialize(memoryview(inline))
@@ -325,13 +380,30 @@ class ClusterRuntime:
             raise exc.ObjectLostError(f"object {oid.hex()[:12]} lost from store")
         # pull through local nodelet into local store, then read zero-copy
         if self.nodelet_address and self.store is not None:
-            r = self.client.call(self.nodelet_address, "fetch_object",
-                                 {"oid": oid, "location": location}, timeout=120)
-            if r.get("ok") and self.store.contains(oid):
-                return self._pinned_deserialize(oid)
-        # last resort: direct pull into memory
-        value, frames = self.client.call_frames(location, "pull_object",
-                                                {"oid": oid}, timeout=120)
+            try:
+                r = self.client.call(self.nodelet_address, "fetch_object",
+                                     {"oid": oid, "location": location},
+                                     timeout=90)
+                if r.get("ok") and self.store.contains(oid):
+                    return self._pinned_deserialize(oid)
+            except Exception:  # noqa: BLE001
+                pass  # holder node unreachable: fall through
+        # last resort: direct pull into memory. Probe liveness first so
+        # a dead holder fails fast, while a live holder gets the full
+        # window for a big single-frame transfer.
+        try:
+            self.client.call(location, "ping", {}, timeout=5, retries=1)
+        except Exception as e:  # noqa: BLE001
+            raise exc.ObjectLostError(
+                f"object {oid.hex()[:12]}: holder {location} unreachable "
+                f"({e})") from e
+        try:
+            value, frames = self.client.call_frames(location, "pull_object",
+                                                    {"oid": oid}, timeout=120)
+        except Exception as e:  # noqa: BLE001
+            raise exc.ObjectLostError(
+                f"object {oid.hex()[:12]}: pull from {location} failed "
+                f"({e})") from e
         if not value.get("ok"):
             raise exc.ObjectLostError(f"object {oid.hex()[:12]}: "
                                       f"{value.get('error')}")
@@ -618,6 +690,30 @@ class ClusterRuntime:
         for b in oids or ():
             self._decref(b)
 
+    def _normalized_runtime_env(self, runtime_env):
+        from ray_tpu.core import runtime_env as rtenv
+
+        key = None
+        if runtime_env:
+            # the cache key must track working_dir CONTENT (mtime/size
+            # fingerprint), or edits between submits ship stale code
+            fp = ""
+            wd = runtime_env.get("working_dir")
+            if wd:
+                fp = rtenv.dir_fingerprint(wd)
+            key = ("rtenv", json_stable(runtime_env), fp)
+            with self._lock:
+                cached = self._rtenv_cache.get(key)
+            if cached is not None:
+                return cached
+        norm = rtenv.normalize(runtime_env, self.client, self.head_address)
+        if key is not None:
+            with self._lock:
+                if len(self._rtenv_cache) > 64:
+                    self._rtenv_cache.clear()
+                self._rtenv_cache[key] = norm
+        return norm
+
     def submit_task(self, fn, args, kwargs, opts: TaskOptions):
         n = opts.num_returns
         oids = [ObjectID.random() for _ in range(n)]
@@ -639,6 +735,7 @@ class ClusterRuntime:
             placement_group=pg_id,
             bundle_index=opts.placement_group_bundle_index,
             label_selector=opts.label_selector,
+            runtime_env=self._normalized_runtime_env(opts.runtime_env),
         )
         with self._lock:
             for o in oids:
@@ -705,6 +802,7 @@ class ClusterRuntime:
             placement_group=pg.id.binary() if pg is not None else None,
             bundle_index=opts.placement_group_bundle_index,
             label_selector=opts.label_selector,
+            runtime_env=self._normalized_runtime_env(opts.runtime_env),
         )
         blob = cloudpickle.dumps(cls)
         r = self.client.call(self.head_address, "create_actor",
@@ -907,6 +1005,12 @@ class ClusterRuntime:
         # NOTE: the shared RpcClient is intentionally left alive — other
         # in-process services (test Cluster fixtures, a second init())
         # share it; peers to dead addresses are harmless.
+
+
+def json_stable(d) -> str:
+    import json
+
+    return json.dumps(d, sort_keys=True, default=str)
 
 
 def _detect_tpu_chips() -> int:

@@ -1,0 +1,98 @@
+"""Central config/flag registry.
+
+Reference parity: the RAY_CONFIG macro registry
+(src/ray/common/ray_config_def.h:18 — typed defaults,每 flag
+overridable via RAY_<name> env vars, serialized head->nodes). Here:
+typed defaults overridable via RAY_TPU_<NAME> env vars; `snapshot()`
+serializes the effective config so a head can hand it to joining
+nodes."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any
+
+# name -> (type, default, description)
+_DEFS: dict[str, tuple[type, Any, str]] = {
+    # --- rpc/transport
+    "NODE_IP": (str, "", "bind/advertise IP ('' = loopback, 'auto' = detect)"),
+    "RPC_TIMEOUT_S": (float, 30.0, "default blocking RPC timeout"),
+    "TESTING_RPC_FAILURE": (str, "", "chaos: 'method=N,...' drop budgets"),
+    # --- head
+    "HEARTBEAT_INTERVAL_S": (float, 0.5, "nodelet->head resource heartbeat"),
+    "NODE_DEATH_AFTER_S": (float, 5.0, "heartbeat age before node is dead"),
+    "PG_RETRY_INTERVAL_S": (float, 0.5, "pending placement-group replan"),
+    "ACTOR_SCHEDULE_DEADLINE_S": (float, 60.0,
+                                  "give up placing an actor after this"),
+    # --- nodelet / workers
+    "MAX_WORKERS": (int, 0, "task worker-pool cap (0 = CPU count)"),
+    "PRESTART_WORKERS": (int, 0, "warm workers spawned at nodelet start"),
+    "WORKER_START_TIMEOUT_S": (float, 60.0, "worker boot deadline"),
+    "MAX_SPILLBACKS": (int, 4, "scheduling hops before running anywhere"),
+    "PULL_CHUNK_BYTES": (int, 4 * 1024 * 1024,
+                         "node-to-node object transfer chunk"),
+    # --- object store
+    "OBJECT_STORE_BYTES": (int, 512 * 1024 * 1024, "shm store capacity"),
+    "INLINE_THRESHOLD_BYTES": (int, 64 * 1024,
+                               "values at/below ride inline in RPCs"),
+    # --- tasks
+    "TASK_MAX_RETRIES": (int, 3, "default task retry budget"),
+}
+
+_lock = threading.Lock()
+_cache: dict[str, Any] = {}
+
+
+def _coerce(typ: type, raw: str) -> Any:
+    if typ is bool:
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+    return typ(raw)
+
+
+def get(name: str) -> Any:
+    """Effective value of a flag: programmatic override, else
+    RAY_TPU_<name> env, else the registered default. Env values are NOT
+    cached so test fixtures can monkeypatch them per-case."""
+    if name not in _DEFS:
+        raise KeyError(f"unknown config flag {name!r}")
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+    typ, default, _ = _DEFS[name]
+    raw = os.environ.get(f"RAY_TPU_{name}")
+    return default if raw is None else _coerce(typ, raw)
+
+
+def set_override(name: str, value: Any):
+    """Programmatic override (tests; reference: RayConfig initialize)."""
+    if name not in _DEFS:
+        raise KeyError(f"unknown config flag {name!r}")
+    with _lock:
+        _cache[name] = _DEFS[name][0](value)
+
+
+def reset():
+    with _lock:
+        _cache.clear()
+
+
+def describe() -> dict[str, dict]:
+    return {
+        name: {"type": typ.__name__, "default": default, "doc": doc,
+               "value": get(name)}
+        for name, (typ, default, doc) in _DEFS.items()
+    }
+
+
+def snapshot() -> str:
+    """Serialized effective config (head hands this to joining nodes —
+    reference: raylet_config_list, gcs_server.h:65)."""
+    return json.dumps({name: get(name) for name in _DEFS})
+
+
+def apply_snapshot(blob: str):
+    for name, value in json.loads(blob).items():
+        if name in _DEFS:
+            set_override(name, value)

@@ -46,11 +46,16 @@ class _ActorRecord:
 
 
 class Head:
-    def __init__(self, session_name: str = "session"):
+    def __init__(self, session_name: str = "session", storage=None):
+        from ray_tpu.core.head_storage import InMemoryHeadStore
+
         self.server = RpcServer(name="head", num_threads=32)
         self.address = self.server.address
         self.client = RpcClient.shared()
         self.session_name = session_name
+        # pluggable metadata store (reference: gcs store_client seam) —
+        # FileHeadStore makes KV/actors/jobs survive a head restart
+        self.storage = storage or InMemoryHeadStore()
 
         self._lock = threading.RLock()
         self._nodes: dict[bytes, NodeInfo] = {}
@@ -62,6 +67,12 @@ class Head:
         self._subs: dict[str, set[str]] = {}  # topic -> subscriber addresses
         self._pgs = {}  # placement groups: pg_id -> record (see placement.py)
         self._stopped = threading.Event()
+        # storage writes are queued IN LOCK ORDER and drained by one
+        # writer thread: disk order then matches memory order without
+        # doing blocking I/O under the head lock
+        self._persist_queue: list[tuple] = []
+        self._persist_wake = threading.Event()
+        self._restore_from_storage()
 
         s = self.server
         s.register("register_node", self._h_register_node)
@@ -88,14 +99,80 @@ class Head:
                                          name="head-monitor")
         self._pg_retry = threading.Thread(target=self._pg_retry_loop,
                                           daemon=True, name="head-pg-retry")
+        self._persister = threading.Thread(target=self._persist_loop,
+                                           daemon=True, name="head-persist")
+
+    def _restore_from_storage(self):
+        """Reload persisted tables (reference: gcs_init_data.h — the GCS
+        reloads state on boot; live nodes re-register via heartbeats).
+        Actors that were ALIVE when the head died are marked DEAD: their
+        workers registered with the previous incarnation."""
+        from ray_tpu.core import head_storage as hs
+
+        for key, blob in self.storage.scan("kv"):
+            ns, _, k = key.partition("\x00")
+            self._kv.setdefault(ns, {})[k] = blob
+        for aid, blob in self.storage.scan("actors"):
+            try:
+                rec_data = hs.loads(blob)
+            except Exception:  # noqa: BLE001
+                continue
+            rec = _ActorRecord(rec_data["spec"])
+            rec.state = ActorState.DEAD
+            rec.death_cause = (rec_data.get("death_cause") or
+                               "head restarted")
+            self._actors[aid] = rec
+            if rec.spec.name:
+                self._named.setdefault(
+                    (rec.spec.namespace, rec.spec.name), aid)
+
+    def _persist_actor(self, rec: "_ActorRecord"):
+        from ray_tpu.core import head_storage as hs
+
+        try:
+            self.storage.put("actors", rec.spec.actor_id, hs.dumps({
+                "spec": rec.spec, "state": rec.state,
+                "death_cause": rec.death_cause}))
+        except Exception:  # noqa: BLE001
+            pass
 
     def start(self):
         self.server.start()
         self._monitor.start()
         self._pg_retry.start()
+        self._persister.start()
         return self
 
+    def _enqueue_persist(self, op: str, table: str, key, value=None):
+        # caller holds self._lock: queue order == memory mutation order
+        self._persist_queue.append((op, table, key, value))
+        self._persist_wake.set()
+
+    def _persist_loop(self):
+        while not self._stopped.is_set():
+            self._persist_wake.wait(timeout=0.2)
+            self._persist_wake.clear()
+            while True:
+                with self._lock:
+                    if not self._persist_queue:
+                        break
+                    op, table, key, value = self._persist_queue.pop(0)
+                try:
+                    if op == "put":
+                        self.storage.put(table, key, value)
+                    else:
+                        self.storage.delete(table, key)
+                except Exception:  # noqa: BLE001
+                    pass
+
     def stop(self):
+        # flush queued persists before stopping
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._persist_queue:
+                    break
+            time.sleep(0.02)
         self._stopped.set()
         self.server.stop()
 
@@ -166,7 +243,10 @@ class Head:
             table = self._kv.setdefault(ns, {})
             exists = msg["key"] in table
             if msg.get("overwrite", True) or not exists:
-                table[msg["key"]] = frames[0] if frames else msg.get("value", b"")
+                value = frames[0] if frames else msg.get("value", b"")
+                table[msg["key"]] = value
+                self._enqueue_persist("put", "kv", f"{ns}\x00{msg['key']}",
+                                      value)
         return {"added": not exists}
 
     def _h_kv_get(self, msg, frames):
@@ -175,9 +255,12 @@ class Head:
         return ({"found": v is not None}, [v] if v is not None else [])
 
     def _h_kv_del(self, msg, frames):
+        ns = msg.get("ns", "default")
         with self._lock:
-            return {"deleted": self._kv.get(msg.get("ns", "default"), {})
-                    .pop(msg["key"], None) is not None}
+            removed = self._kv.get(ns, {}).pop(msg["key"], None) is not None
+            if removed:
+                self._enqueue_persist("del", "kv", f"{ns}\x00{msg['key']}")
+            return {"deleted": removed}
 
     def _h_kv_keys(self, msg, frames):
         prefix = msg.get("prefix", b"")
@@ -202,6 +285,7 @@ class Head:
                         raise ValueError(f"actor name {spec.name!r} already taken")
                 self._named[key] = spec.actor_id
             self._actors[spec.actor_id] = _ActorRecord(spec)
+        self._persist_actor(self._actors[spec.actor_id])
         self._schedule_actor(self._actors[spec.actor_id])
         return {"actor_id": spec.actor_id, "existing": False}
 
@@ -325,6 +409,7 @@ class Head:
             rec.cond.notify_all()
         self._publish("actor", {"event": "restarting" if restart else "dead",
                                 "actor_id": rec.spec.actor_id.hex(), "cause": cause})
+        self._persist_actor(rec)
         if restart:
             self._schedule_actor(rec)
 

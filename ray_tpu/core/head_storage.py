@@ -1,0 +1,117 @@
+"""Pluggable head metadata storage.
+
+Reference parity: the GCS store client seam (gcs/store_client/
+store_client.h:34 — InMemoryStoreClient / RedisStoreClient) that lets
+the control plane survive restarts (gcs_init_data.h: the GCS reloads
+tables on boot). Backends: in-memory (default, no persistence) and a
+file-backed store (atomic per-key files under a directory — the
+single-box equivalent of the Redis deployment). The head persists its
+KV, named-actor registry, actor specs and job records through this seam;
+on restart it reloads them so `kv_get`, named lookups and job history
+survive a control-plane bounce (nodes re-register via their heartbeats)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Iterator
+
+
+class HeadStore:
+    """ABC: tables of key(bytes|str) -> value(bytes)."""
+
+    def put(self, table: str, key, value: bytes):
+        raise NotImplementedError
+
+    def get(self, table: str, key) -> bytes | None:
+        raise NotImplementedError
+
+    def delete(self, table: str, key):
+        raise NotImplementedError
+
+    def scan(self, table: str) -> Iterator[tuple[object, bytes]]:
+        raise NotImplementedError
+
+
+class InMemoryHeadStore(HeadStore):
+    def __init__(self):
+        self._t: dict[str, dict] = {}
+
+    def put(self, table, key, value):
+        self._t.setdefault(table, {})[key] = value
+
+    def get(self, table, key):
+        return self._t.get(table, {}).get(key)
+
+    def delete(self, table, key):
+        self._t.get(table, {}).pop(key, None)
+
+    def scan(self, table):
+        yield from self._t.get(table, {}).items()
+
+
+def _key_name(key) -> str:
+    # hex-encode both kinds: keys may contain separators/NULs
+    if isinstance(key, bytes):
+        return "b_" + key.hex()
+    return "s_" + str(key).encode("utf-8").hex()
+
+
+def _key_parse(name: str):
+    if name.startswith("b_"):
+        return bytes.fromhex(name[2:])
+    return bytes.fromhex(name[2:]).decode("utf-8")
+
+
+class FileHeadStore(HeadStore):
+    """One file per key, atomic renames; good enough for control-plane
+    metadata rates (the reference's Redis plays this role)."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _dir(self, table: str) -> str:
+        d = os.path.join(self.root, table.replace("/", "%2F"))
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def put(self, table, key, value):
+        path = os.path.join(self._dir(table), _key_name(key))
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(value)
+        os.replace(tmp, path)
+
+    def get(self, table, key):
+        path = os.path.join(self._dir(table), _key_name(key))
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def delete(self, table, key):
+        try:
+            os.unlink(os.path.join(self._dir(table), _key_name(key)))
+        except FileNotFoundError:
+            pass
+
+    def scan(self, table):
+        d = self._dir(table)
+        for name in os.listdir(d):
+            if name.endswith(".tmp"):
+                continue
+            try:
+                with open(os.path.join(d, name), "rb") as f:
+                    yield _key_parse(name), f.read()
+            except FileNotFoundError:
+                continue
+
+
+def dumps(obj) -> bytes:
+    return pickle.dumps(obj)
+
+
+def loads(blob: bytes):
+    return pickle.loads(blob)

@@ -25,20 +25,23 @@ import threading
 import time
 from collections import deque
 
+from ray_tpu.core import config as cfg
 from ray_tpu.core import serialization as ser
 from ray_tpu.core.head import HEARTBEAT_INTERVAL_S, dataclass_dict
 from ray_tpu.core.object_store import open_store
 from ray_tpu.core.rpc import RpcClient, RpcServer
 from ray_tpu.core.specs import ActorSpec, TaskSpec
 
-MAX_SPILLBACKS = 4
+
 
 
 class _Worker:
     __slots__ = ("worker_id", "proc", "address", "idle", "current_task",
-                 "actor_id", "ready", "acquired", "tpu", "bundle")
+                 "actor_id", "ready", "acquired", "tpu", "bundle",
+                 "env_hash")
 
-    def __init__(self, worker_id: bytes, proc, tpu: bool = False):
+    def __init__(self, worker_id: bytes, proc, tpu: bool = False,
+                 env_hash: str = ""):
         self.worker_id = worker_id
         self.proc = proc
         self.address = None
@@ -52,6 +55,7 @@ class _Worker:
         self.acquired: dict[str, float] = {}
         self.bundle = None  # ((pg_id, idx), resources) for PG-metered work
         self.tpu = tpu  # spawned with TPU device visibility
+        self.env_hash = env_hash  # runtime-env identity for reuse matching
 
 
 class Nodelet:
@@ -79,6 +83,10 @@ class Nodelet:
         self._lock = threading.RLock()
         self._available = dict(self.resources)
         self._queue: deque[TaskSpec] = deque()
+        # resources demanded by queued (not yet dispatched) non-PG tasks:
+        # _place must see them or a submission burst that outraces the
+        # dispatch thread all lands locally instead of spilling
+        self._queued_demand: dict[str, float] = {}
         self._workers: dict[bytes, _Worker] = {}
         self._idle_workers: deque[_Worker] = deque()
         self._bundles: dict[tuple, dict] = {}  # (pg_id, idx) -> reserved
@@ -98,8 +106,8 @@ class Nodelet:
         # Worker-pool cap (reference: WorkerPool caps by cores,
         # raylet/worker_pool.h:216). Actors get dedicated processes and
         # are gated by resources instead.
-        env_cap = os.environ.get("RAY_TPU_MAX_WORKERS")
-        self._max_task_workers = (int(env_cap) if env_cap else
+        env_cap = cfg.get("MAX_WORKERS")
+        self._max_task_workers = (env_cap if env_cap else
                                   max(2, int(self.resources.get("CPU", 0) or
                                              (os.cpu_count() or 8))))
 
@@ -145,7 +153,7 @@ class Nodelet:
             t.start()
         # prestart warm workers (reference: WorkerPool prestart,
         # worker_pool.h:216) — they register idle via worker_ready
-        n_prestart = int(os.environ.get("RAY_TPU_PRESTART_WORKERS", "0"))
+        n_prestart = cfg.get("PRESTART_WORKERS")
         for _ in range(min(n_prestart, self._max_task_workers)):
             self._spawn_worker()
         return self
@@ -185,11 +193,30 @@ class Nodelet:
 
     # ------------------------------------------------------------ workers
 
-    def _spawn_worker(self, tpu: bool = False) -> _Worker:
+    def _spawn_worker(self, tpu: bool = False,
+                      runtime_env: dict | None = None) -> _Worker:
+        from ray_tpu.core import runtime_env as rtenv
         from ray_tpu.core.ids import WorkerID
 
         wid = WorkerID.random().binary()
         env = dict(os.environ)
+        cwd = None
+        ehash = rtenv.env_hash(runtime_env)
+        if runtime_env:
+            extra, cwd = rtenv.materialize(runtime_env, self.session_dir,
+                                           self.client, self.head_address)
+            env.update(extra)
+        if cwd is not None:
+            # the worker normally imports ray_tpu via the launch cwd; a
+            # working_dir cwd override must keep the framework importable
+            import ray_tpu as _pkg
+
+            pkg_root = os.path.dirname(os.path.dirname(
+                os.path.abspath(_pkg.__file__)))
+            prev = env.get("PYTHONPATH", "")
+            if pkg_root not in prev.split(os.pathsep):
+                env["PYTHONPATH"] = prev + (os.pathsep if prev else "") + \
+                    pkg_root
         env["RAY_TPU_NODELET_ADDR"] = self.address
         env["RAY_TPU_HEAD_ADDR"] = self.head_address
         env["RAY_TPU_STORE_NAME"] = self.store.name
@@ -216,9 +243,9 @@ class Nodelet:
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_main"],
             env=env, stdout=log, stderr=subprocess.STDOUT,
-            start_new_session=True,
+            start_new_session=True, cwd=cwd,
         )
-        w = _Worker(wid, proc, tpu=tpu)
+        w = _Worker(wid, proc, tpu=tpu, env_hash=ehash)
         with self._lock:
             self._workers[wid] = w
         return w
@@ -324,11 +351,13 @@ class Nodelet:
         if target == "local":
             with self._lock:
                 self._queue.append(spec)
+                self._add_queued_demand(spec, +1)
             self._dispatch_wake.set()
             return {"queued": "local"}
         if target is None:
             with self._lock:  # queue anyway; resources may appear
                 self._queue.append(spec)
+                self._add_queued_demand(spec, +1)
             self._dispatch_wake.set()
             return {"queued": "infeasible-wait"}
         # spillback (reference: normal_task_submitter.cc:451 retry at
@@ -347,10 +376,13 @@ class Nodelet:
                 # run them against the reservation.
                 return "local"
             fits_total = all(self.resources.get(r, 0.0) >= q for r, q in req.items())
-            fits_now = all(self._available.get(r, 0.0) >= q for r, q in req.items())
+            fits_now = all(
+                self._available.get(r, 0.0) -
+                self._queued_demand.get(r, 0.0) >= q
+                for r, q in req.items())
             queue_len = len(self._queue)
         if fits_now or (fits_total and queue_len < 2) or \
-                spec.spillback_count >= MAX_SPILLBACKS:
+                spec.spillback_count >= cfg.get("MAX_SPILLBACKS"):
             return "local" if fits_total or spec.placement_group else None
         # look for a better node
         view = self._cluster_view_cached()
@@ -381,6 +413,16 @@ class Nodelet:
             except Exception:
                 pass
         return self._cluster_view
+
+    def _add_queued_demand(self, spec: TaskSpec, sign: int):
+        if spec.placement_group is not None:
+            return  # PG tasks are metered against their bundle
+        for r, q in spec.resources.items():
+            v = self._queued_demand.get(r, 0.0) + sign * q
+            if v <= 1e-9:
+                self._queued_demand.pop(r, None)
+            else:
+                self._queued_demand[r] = v
 
     def _can_run(self, req: dict) -> bool:
         return all(self._available.get(r, 0.0) >= q for r, q in req.items())
@@ -456,17 +498,23 @@ class Nodelet:
                             break  # bundle full: wait for a release
                         if bundle_key == self._BUNDLE_REJECT:
                             self._queue.popleft()
+                            self._add_queued_demand(spec, -1)
                             reject = spec
                     if reject is None:
                         if not self._can_run(req):
                             break
                         needs_tpu = spec.resources.get("TPU", 0) > 0
+                        from ray_tpu.core import runtime_env as _rtenv
+
+                        want_env = _rtenv.env_hash(spec.runtime_env)
                         w = None
                         # reuse-first: prefer an idle worker whose device
-                        # visibility matches the task's TPU claim
+                        # visibility AND runtime env match (reference:
+                        # runtime-env-keyed worker pools, worker_pool.h)
                         for cand in list(self._idle_workers):
                             if cand.worker_id in self._workers and \
-                                    cand.tpu == needs_tpu:
+                                    cand.tpu == needs_tpu and \
+                                    cand.env_hash == want_env:
                                 w = cand
                                 self._idle_workers.remove(cand)
                                 break
@@ -503,6 +551,7 @@ class Nodelet:
                             for r, q in spec.resources.items():
                                 free[r] = free.get(r, 0.0) - q
                         self._queue.popleft()
+                        self._add_queued_demand(spec, -1)
                 if reject is not None:
                     self._fail_task(
                         reject,
@@ -510,7 +559,26 @@ class Nodelet:
                         f"its placement-group bundle reservation")
                     continue
                 if w is None:
-                    w = self._spawn_worker(tpu=needs_tpu)
+                    try:
+                        w = self._spawn_worker(tpu=needs_tpu,
+                                               runtime_env=spec.runtime_env)
+                    except Exception as e:  # noqa: BLE001
+                        # bad runtime env (missing KV blob, corrupt zip,
+                        # head unreachable) must not kill the dispatch
+                        # thread: fail THIS task, release, keep going
+                        with self._lock:
+                            for r, q in req.items():
+                                self._available[r] = min(
+                                    self.resources.get(r, 0.0),
+                                    self._available[r] + q)
+                            if bundle_key is not None:
+                                free = self._bundle_free.get(bundle_key)
+                                if free is not None:
+                                    for r, q in spec.resources.items():
+                                        free[r] = free.get(r, 0.0) + q
+                        self._fail_task(
+                            spec, f"worker environment setup failed: {e}")
+                        continue
                 with self._lock:
                     for r, q in req.items():
                         w.acquired[r] = w.acquired.get(r, 0.0) + q
@@ -583,7 +651,19 @@ class Nodelet:
                 free = self._bundle_free[bundle_key]
                 for r, q in spec.resources.items():
                     free[r] = free.get(r, 0.0) - q
-        w = self._spawn_worker(tpu=needs_tpu)
+        try:
+            w = self._spawn_worker(tpu=needs_tpu,
+                                   runtime_env=spec.runtime_env)
+        except Exception:
+            # env materialization failed: roll back the bundle decrement
+            # or the PG permanently loses capacity on this node
+            if bundle_key is not None:
+                with self._lock:
+                    free = self._bundle_free.get(bundle_key)
+                    if free is not None:
+                        for r, q in spec.resources.items():
+                            free[r] = free.get(r, 0.0) + q
+            raise
         if not self._acquire_for(w, req):
             with self._lock:
                 self._workers.pop(w.worker_id, None)
@@ -635,7 +715,7 @@ class Nodelet:
     # Node-to-node transfers move in bounded chunks so a large object
     # never needs 2x its size in transient buffers on either side
     # (reference: chunked ObjectBufferPool transfers, object_manager.h:117)
-    PULL_CHUNK = 4 * 1024 * 1024
+    PULL_CHUNK = property(lambda self: cfg.get("PULL_CHUNK_BYTES"))
 
     def _h_fetch_object(self, msg, frames):
         """Ensure an object is present in the local store, pulling from
@@ -648,7 +728,7 @@ class Nodelet:
         if not location:
             return {"ok": False, "error": "no location"}
         meta = self.client.call(location, "object_meta", {"oid": oid},
-                                timeout=30, retries=2)
+                                timeout=15, retries=1)
         if not meta.get("ok"):
             return {"ok": False, "error": meta.get("error", "meta failed")}
         size = meta["size"]
@@ -665,7 +745,7 @@ class Nodelet:
                 value, frames_in = self.client.call_frames(
                     location, "pull_chunk",
                     {"oid": oid, "offset": off, "size": n},
-                    timeout=60, retries=2)
+                    timeout=30, retries=1)
                 if not value.get("ok"):
                     raise RuntimeError(value.get("error", "pull failed"))
                 buf[off:off + n] = frames_in[0]

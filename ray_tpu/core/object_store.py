@@ -22,7 +22,11 @@ import os
 import secrets
 import threading
 
-DEFAULT_CAPACITY = int(os.environ.get("RAY_TPU_OBJECT_STORE_BYTES", 512 * 1024 * 1024))
+from ray_tpu.core import config as _cfg
+
+
+def default_capacity() -> int:
+    return _cfg.get("OBJECT_STORE_BYTES")
 _TABLE_CAPACITY = 65536
 
 _SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else (
@@ -121,8 +125,9 @@ def native_lib():
 class SharedMemoryStore:
     """One shm segment, native allocator. All sizes in bytes."""
 
-    def __init__(self, name: str | None = None, capacity: int = DEFAULT_CAPACITY,
+    def __init__(self, name: str | None = None, capacity: int | None = None,
                  create: bool = True):
+        capacity = capacity if capacity is not None else default_capacity()
         self._lib = native_lib()
         if self._lib is None:
             raise RuntimeError("native object store library unavailable")
@@ -286,8 +291,9 @@ class SegmentPerObjectStore:
                 self.delete(oid)
 
 
-def open_store(name: str | None = None, capacity: int = DEFAULT_CAPACITY,
+def open_store(name: str | None = None, capacity: int | None = None,
                create: bool = True):
+    capacity = capacity if capacity is not None else default_capacity()
     if native_lib() is not None:
         return SharedMemoryStore(name=name, capacity=capacity, create=create)
     return SegmentPerObjectStore(name=name, capacity=capacity, create=create)

@@ -22,6 +22,9 @@ class TaskOptions:
     placement_group: Any = None
     placement_group_bundle_index: int = -1
     label_selector: dict[str, str] | None = None
+    # {"env_vars": {...}, "working_dir": path} (reference:
+    # _private/runtime_env/ — env materialized before the worker starts)
+    runtime_env: dict | None = None
 
     def resource_request(self) -> dict[str, float]:
         req = dict(self.resources)
@@ -48,6 +51,7 @@ class ActorOptions:
     placement_group_bundle_index: int = -1
     get_if_exists: bool = False
     label_selector: dict[str, str] | None = None
+    runtime_env: dict | None = None
 
     def resource_request(self) -> dict[str, float]:
         req = dict(self.resources)
@@ -63,7 +67,7 @@ class ActorOptions:
 _TASK_KEYS = {f.name for f in dataclasses.fields(TaskOptions)}
 _ACTOR_KEYS = {f.name for f in dataclasses.fields(ActorOptions)}
 # accepted-but-ignored (compat shims, recorded for parity)
-_SOFT_KEYS = {"runtime_env", "memory", "accelerator_type", "num_gpus",
+_SOFT_KEYS = {"memory", "accelerator_type", "num_gpus",
               "_metadata", "enable_task_events", "concurrency_groups"}
 
 

@@ -1,0 +1,128 @@
+"""Runtime environments for tasks/actors.
+
+Reference parity: python/ray/_private/runtime_env/ — per-task/actor
+environments materialized on the node BEFORE the worker starts
+(working_dir.py: zipped dirs shipped via GCS and extracted per node;
+plugin env_vars). Scope: env_vars + working_dir (the two the reference
+lists first); pip/conda isolation is out of scope in this image (no
+installs allowed) and gated with a clear error."""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import zipfile
+
+_SUPPORTED = {"env_vars", "working_dir"}
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+_MAX_WORKING_DIR_BYTES = 256 * 1024 * 1024
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    total = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs if d not in _SKIP_DIRS]
+            for f in files:
+                full = os.path.join(root, f)
+                rel = os.path.relpath(full, path)
+                total += os.path.getsize(full)
+                if total > _MAX_WORKING_DIR_BYTES:
+                    raise ValueError(
+                        f"working_dir {path} exceeds "
+                        f"{_MAX_WORKING_DIR_BYTES} bytes")
+                z.write(full, rel)
+    return buf.getvalue()
+
+
+def dir_fingerprint(path: str) -> str:
+    """Cheap content identity for cache keys: (relpath, mtime_ns, size)
+    of every file. Changes when the directory content changes without
+    paying for a re-zip."""
+    h = hashlib.sha1()
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+        for f in sorted(files):
+            full = os.path.join(root, f)
+            try:
+                st = os.stat(full)
+            except OSError:
+                continue
+            h.update(os.path.relpath(full, path).encode())
+            h.update(f"{st.st_mtime_ns}:{st.st_size}".encode())
+    return h.hexdigest()
+
+
+def normalize(runtime_env: dict | None, client, head_address: str
+              ) -> dict | None:
+    """Validate + make shippable: working_dir is zipped and uploaded to
+    the head KV once (content-addressed), replaced by its key."""
+    if not runtime_env:
+        return None
+    unknown = set(runtime_env) - _SUPPORTED
+    if unknown:
+        raise ValueError(
+            f"unsupported runtime_env keys {sorted(unknown)}; supported: "
+            f"{sorted(_SUPPORTED)} (pip/conda need installs, unavailable "
+            f"in this deployment)")
+    out: dict = {}
+    env_vars = runtime_env.get("env_vars")
+    if env_vars:
+        out["env_vars"] = {str(k): str(v) for k, v in env_vars.items()}
+    wd = runtime_env.get("working_dir")
+    if wd:
+        if not os.path.isdir(wd):
+            raise ValueError(f"working_dir {wd!r} is not a directory")
+        blob = _zip_dir(wd)
+        key = hashlib.sha1(blob).hexdigest()
+        client.call(head_address, "kv_put",
+                    {"ns": "rtenv", "key": key, "overwrite": False},
+                    frames=[blob], timeout=60, retries=2)
+        out["working_dir_key"] = key
+    return out or None
+
+
+def env_hash(norm: dict | None) -> str:
+    if not norm:
+        return ""
+    return hashlib.sha1(
+        json.dumps(norm, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def materialize(norm: dict | None, session_dir: str, client,
+                head_address: str) -> tuple[dict, str | None]:
+    """Node-side: returns (extra process env, cwd or None). Extraction is
+    content-addressed and idempotent (reference: the per-node runtime-env
+    agent materializes before WorkerPool starts the worker)."""
+    if not norm:
+        return {}, None
+    extra = dict(norm.get("env_vars") or {})
+    cwd = None
+    key = norm.get("working_dir_key")
+    if key:
+        dest = os.path.join(session_dir, "runtime_envs", key)
+        done = os.path.join(dest, ".ready")
+        if not os.path.exists(done):
+            value, frames = client.call_frames(
+                head_address, "kv_get", {"ns": "rtenv", "key": key},
+                timeout=60, retries=2)
+            if not value.get("found"):
+                raise RuntimeError(f"runtime_env working_dir {key} not in KV")
+            tmp = dest + ".tmp"
+            os.makedirs(tmp, exist_ok=True)
+            with zipfile.ZipFile(io.BytesIO(frames[0])) as z:
+                z.extractall(tmp)
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            try:
+                os.rename(tmp, dest)
+            except OSError:
+                pass  # concurrent materialization won
+            with open(done, "w") as f:
+                f.write("ok")
+        cwd = dest
+        prev = extra.get("PYTHONPATH", os.environ.get("PYTHONPATH", ""))
+        extra["PYTHONPATH"] = dest + (os.pathsep + prev if prev else "")
+    return extra, cwd

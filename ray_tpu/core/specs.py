@@ -45,6 +45,8 @@ class TaskSpec:
     placement_group: bytes | None = None
     bundle_index: int = -1
     label_selector: dict | None = None
+    # normalized runtime env: {"env_vars": {...}, "working_dir_key": sha}
+    runtime_env: dict | None = None
 
 
 @dataclasses.dataclass
@@ -63,6 +65,7 @@ class ActorSpec:
     placement_group: bytes | None = None
     bundle_index: int = -1
     label_selector: dict | None = None
+    runtime_env: dict | None = None
 
 
 @dataclasses.dataclass

@@ -1,0 +1,135 @@
+"""Dashboard-lite: HTTP view of cluster state.
+
+Reference parity: the dashboard head's REST surface
+(python/ray/dashboard/head.py + modules/{node,actor,job}) scoped to the
+state endpoints and a minimal auto-refreshing HTML page — no React
+frontend. Serves: / (HTML), /api/state, /api/nodes, /api/actors,
+/api/pgs, /api/jobs, /metrics (this process's Prometheus registry)."""
+
+from __future__ import annotations
+
+import json
+import threading
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<meta http-equiv="refresh" content="5">
+<style>
+ body { font-family: monospace; margin: 2em; }
+ table { border-collapse: collapse; margin-bottom: 2em; }
+ td, th { border: 1px solid #999; padding: 4px 10px; text-align: left; }
+ h2 { margin-bottom: .3em; }
+</style></head>
+<body>
+<h1>ray_tpu cluster</h1>
+<div id="content">loading…</div>
+<script>
+async function load() {
+  const s = await (await fetch('/api/state')).json();
+  const nodes = await (await fetch('/api/nodes')).json();
+  const actors = await (await fetch('/api/actors')).json();
+  const jobs = await (await fetch('/api/jobs')).json();
+  let h = '<h2>Summary</h2><table>';
+  for (const [k, v] of Object.entries(s))
+    h += `<tr><th>${k}</th><td>${JSON.stringify(v)}</td></tr>`;
+  h += '</table><h2>Nodes</h2><table><tr><th>id</th><th>address</th>' +
+       '<th>alive</th><th>resources</th><th>available</th></tr>';
+  for (const n of nodes)
+    h += `<tr><td>${n.node_id.slice(0,12)}</td><td>${n.address}</td>` +
+         `<td>${n.alive}</td><td>${JSON.stringify(n.resources)}</td>` +
+         `<td>${JSON.stringify(n.available)}</td></tr>`;
+  h += '</table><h2>Actors</h2><table><tr><th>id</th><th>name</th>' +
+       '<th>state</th><th>node</th></tr>';
+  for (const a of actors)
+    h += `<tr><td>${a.actor_id.slice(0,12)}</td><td>${a.name||''}</td>` +
+         `<td>${a.state}</td><td>${(a.node_id||'').slice(0,12)}</td></tr>`;
+  h += '</table><h2>Jobs</h2><table><tr><th>id</th><th>status</th>' +
+       '<th>entrypoint</th></tr>';
+  for (const j of jobs)
+    h += `<tr><td>${j.submission_id}</td><td>${j.status}</td>` +
+         `<td>${j.entrypoint}</td></tr>`;
+  h += '</table>';
+  document.getElementById('content').innerHTML = h;
+}
+load();
+</script></body></html>"""
+
+_server = None
+
+
+def start_dashboard(head_address: str | None = None, port: int = 8265) -> int:
+    """Start the dashboard HTTP server; returns the bound port."""
+    global _server
+    import http.server
+
+    from ray_tpu.util import metrics as metrics_mod
+    from ray_tpu.util import state
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def _send(self, body: bytes, ctype: str, code: int = 200):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            try:
+                if self.path == "/" or self.path == "/index.html":
+                    self._send(_PAGE.encode(), "text/html")
+                elif self.path == "/api/state":
+                    self._send(json.dumps(
+                        state.summarize(head_address)).encode(),
+                        "application/json")
+                elif self.path == "/api/nodes":
+                    self._send(json.dumps(
+                        state.list_nodes(head_address)).encode(),
+                        "application/json")
+                elif self.path == "/api/actors":
+                    self._send(json.dumps(
+                        state.list_actors(head_address)).encode(),
+                        "application/json")
+                elif self.path == "/api/pgs":
+                    self._send(json.dumps(
+                        state.list_placement_groups(head_address),
+                        default=str).encode(), "application/json")
+                elif self.path == "/api/jobs":
+                    self._send(json.dumps(_jobs(head_address)).encode(),
+                               "application/json")
+                elif self.path == "/metrics":
+                    self._send(metrics_mod.prometheus_text().encode(),
+                               "text/plain; version=0.0.4")
+                else:
+                    self._send(b"not found", "text/plain", 404)
+            except Exception as e:  # noqa: BLE001
+                self._send(json.dumps({"error": repr(e)}).encode(),
+                           "application/json", 500)
+
+        def log_message(self, *a):
+            pass
+
+    _server = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=_server.serve_forever, daemon=True,
+                     name="dashboard-http").start()
+    return _server.server_address[1]
+
+
+def _jobs(head_address: str | None) -> list[dict]:
+    try:
+        from ray_tpu.job_submission import JobSubmissionClient
+
+        client = JobSubmissionClient(head_address)
+        return [
+            {"submission_id": j.submission_id, "status": j.status.value,
+             "entrypoint": j.entrypoint}
+            for j in client.list_jobs()
+        ]
+    except Exception:  # noqa: BLE001
+        return []
+
+
+def stop_dashboard():
+    global _server
+    if _server is not None:
+        _server.shutdown()
+        _server = None

@@ -1,0 +1,269 @@
+"""Channels + the Communicator seam (compiled-DAG data plane).
+
+Reference parity: python/ray/experimental/channel/ — the Communicator
+ABC (communicator.py:19, send/recv/allreduce) and shared-memory
+mutable-object channels (shared_memory_channel.py over the C++
+MutableObjectManager). Here:
+
+- `Channel`: named shared-memory SPSC ring (native C++,
+  _native/channel.cc) for same-node cross-process byte streams —
+  microsecond-latency, bypassing the RPC layer and the object store;
+- `ShmCommunicator`: point-to-point Communicator over a full mesh of
+  channels for a named group of local processes;
+- `CollectiveCommunicator`: Communicator whose allreduce rides the
+  host collective module (ray_tpu.util.collective). On-device tensors
+  inside one SPMD program should use in-program XLA collectives instead
+  (ray_tpu.parallel.ops) — that path needs no channel machinery at all.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pickle
+import time
+from typing import Any
+
+from ray_tpu.core.object_store import ShmSegment
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+def _chan_lib():
+    from ray_tpu import _native
+
+    path = _native.build_library("channel")
+    if path is None:
+        raise RuntimeError("native channel library unavailable (no g++?)")
+    lib = ctypes.CDLL(path)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.chan_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.chan_attached_ok.argtypes = [ctypes.c_void_p]
+    lib.chan_close.argtypes = [ctypes.c_void_p]
+    lib.chan_is_closed.argtypes = [ctypes.c_void_p]
+    lib.chan_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_uint64]
+    lib.chan_peek.argtypes = [ctypes.c_void_p, u64p, u64p]
+    lib.chan_peek.restype = ctypes.c_int64
+    lib.chan_pop.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    for f in ("chan_init", "chan_attached_ok", "chan_write",
+              "chan_is_closed"):
+        getattr(lib, f).restype = ctypes.c_int
+    return lib
+
+
+_lib = None
+
+
+def _lib_once():
+    global _lib
+    if _lib is None:
+        _lib = _chan_lib()
+    return _lib
+
+
+class Channel:
+    """Named SPSC byte channel in shared memory."""
+
+    def __init__(self, name: str | None = None, capacity: int = 1 << 20,
+                 create: bool = True):
+        self._lib = _lib_once()
+        if create:
+            self._seg = ShmSegment(name=name, create=True,
+                                   size=capacity + 64)
+            self._base = ctypes.addressof(
+                ctypes.c_char.from_buffer(self._seg._mmap))
+            if self._lib.chan_init(self._base, self._seg.size) != 0:
+                raise ValueError("channel segment too small")
+        else:
+            self._seg = ShmSegment(name=name, create=False)
+            self._base = ctypes.addressof(
+                ctypes.c_char.from_buffer(self._seg._mmap))
+            if self._lib.chan_attached_ok(self._base) != 0:
+                raise ValueError(f"shm segment {name} is not a channel")
+        self.name = self._seg.name
+        self._owner = create
+
+    # -- raw bytes -------------------------------------------------------
+
+    def put_bytes(self, data: bytes, timeout: float | None = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        sleep = 1e-6
+        while True:
+            rc = self._lib.chan_write(self._base, data, len(data))
+            if rc == 0:
+                return
+            if rc == -2:
+                raise ValueError(f"message of {len(data)} bytes exceeds "
+                                 f"channel capacity")
+            if rc == -3:
+                raise ChannelClosed(self.name)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel {self.name} full")
+            time.sleep(sleep)
+            sleep = min(sleep * 2, 0.001)
+
+    def get_bytes(self, timeout: float | None = None) -> bytes:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        off = ctypes.c_uint64()
+        adv = ctypes.c_uint64()
+        sleep = 1e-6
+        while True:
+            n = self._lib.chan_peek(self._base, ctypes.byref(off),
+                                    ctypes.byref(adv))
+            if n >= 0:
+                data = bytes(self._seg.buf[off.value:off.value + n])
+                self._lib.chan_pop(self._base, adv.value)
+                return data
+            if n == -3:
+                raise ChannelClosed(self.name)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel {self.name} empty")
+            time.sleep(sleep)
+            sleep = min(sleep * 2, 0.001)
+
+    # -- objects ---------------------------------------------------------
+
+    def put(self, value: Any, timeout: float | None = None):
+        self.put_bytes(pickle.dumps(value, protocol=5), timeout)
+
+    def get(self, timeout: float | None = None) -> Any:
+        return pickle.loads(self.get_bytes(timeout))
+
+    def close(self):
+        try:
+            self._lib.chan_close(self._base)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def destroy(self):
+        self.close()
+        self._base = None
+        self._seg.close()
+        if self._owner:
+            self._seg.unlink()
+
+
+class Communicator:
+    """ABC (reference: experimental/channel/communicator.py:19)."""
+
+    def send(self, value, peer_rank: int):
+        raise NotImplementedError
+
+    def recv(self, peer_rank: int):
+        raise NotImplementedError
+
+    def allreduce(self, value, op: str = "sum"):
+        raise NotImplementedError
+
+    @property
+    def rank(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def world_size(self) -> int:
+        raise NotImplementedError
+
+
+class ShmCommunicator(Communicator):
+    """Full mesh of shm channels for N same-node processes. Channel
+    (i -> j) is a distinct SPSC ring, so every directed pair is
+    single-producer/single-consumer by construction."""
+
+    def __init__(self, group_name: str, world_size: int, rank: int,
+                 capacity: int = 1 << 20):
+        self._rank = rank
+        self._world = world_size
+        self._chans: dict[tuple[int, int], Channel] = {}
+        for i in range(world_size):
+            for j in range(world_size):
+                if i == j:
+                    continue
+                if i != rank and j != rank:
+                    continue
+                name = f"rtc_{group_name}_{i}_{j}"
+                chan = self._open_or_create(name, capacity)
+                self._chans[(i, j)] = chan
+
+    @staticmethod
+    def _open_or_create(name: str, capacity: int) -> Channel:
+        try:
+            return Channel(name=name, capacity=capacity, create=True)
+        except FileExistsError:
+            return Channel(name=name, create=False)
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def world_size(self) -> int:
+        return self._world
+
+    def send(self, value, peer_rank: int, timeout: float | None = 30.0):
+        self._chans[(self._rank, peer_rank)].put(value, timeout)
+
+    def recv(self, peer_rank: int, timeout: float | None = 30.0):
+        return self._chans[(peer_rank, self._rank)].get(timeout)
+
+    def allreduce(self, value, op: str = "sum"):
+        """Naive gather-to-0 + broadcast (metadata-scale; device tensors
+        belong in in-program XLA collectives)."""
+        import numpy as np
+
+        if self._rank == 0:
+            acc = np.asarray(value)
+            for peer in range(1, self._world):
+                other = np.asarray(self.recv(peer))
+                if op == "sum":
+                    acc = acc + other
+                elif op == "max":
+                    acc = np.maximum(acc, other)
+                elif op == "min":
+                    acc = np.minimum(acc, other)
+                else:
+                    raise ValueError(f"unknown op {op!r}")
+            for peer in range(1, self._world):
+                self.send(acc, peer)
+            return acc
+        self.send(value, 0)
+        return self.recv(0)
+
+    def destroy(self):
+        for ch in self._chans.values():
+            try:
+                ch.destroy()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class CollectiveCommunicator(Communicator):
+    """Communicator over the host collective rendezvous (works across
+    nodes; reference cpu_communicator.py)."""
+
+    def __init__(self, group_name: str, world_size: int, rank: int):
+        from ray_tpu.util import collective as col
+
+        self._col = col
+        self._group = group_name
+        self._rank = rank
+        self._world = world_size
+        col.init_collective_group(world_size, rank, group_name=group_name)
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def world_size(self) -> int:
+        return self._world
+
+    def send(self, value, peer_rank: int):
+        self._col.send(value, peer_rank, group_name=self._group)
+
+    def recv(self, peer_rank: int):
+        return self._col.recv(peer_rank, group_name=self._group)
+
+    def allreduce(self, value, op: str = "sum"):
+        return self._col.allreduce(value, group_name=self._group, op=op)

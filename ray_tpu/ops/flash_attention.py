@@ -308,9 +308,18 @@ def _flash_bwd(cfg: _Cfg, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _fit_block(T: int, want: int) -> int:
+    """Largest power-of-two block <= want that divides T (so e.g. T=1536
+    runs with 512 blocks instead of failing the 1024 default)."""
+    b = min(want, T)
+    while b > 128 and T % b:
+        b //= 2
+    return b
+
+
 def flash_attention(q, k, v, *, causal: bool = True,
                     sm_scale: float | None = None,
-                    block_q: int = 512, block_k: int = 512,
+                    block_q: int = 1024, block_k: int = 1024,
                     interpret: bool = False) -> jax.Array:
     """q,k,v: (B, T, H, D) -> (B, T, H, D).
 
@@ -318,8 +327,8 @@ def flash_attention(q, k, v, *, causal: bool = True,
     divisible by the block sizes (the dispatcher in ops.attention falls
     back to the einsum path otherwise)."""
     B, T, H, D = q.shape
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
+    block_q = _fit_block(T, block_q)
+    block_k = _fit_block(T, block_k)
     if T % block_q or T % block_k:
         raise ValueError(f"T={T} not divisible by blocks "
                          f"({block_q},{block_k})")

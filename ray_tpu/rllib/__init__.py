@@ -7,16 +7,20 @@ whose update is a jitted SPMD program over a jax mesh
 Algorithm drivers starting with PPO (algorithms/ppo/ppo.py:389).
 """
 
+from ray_tpu.rllib.dqn import DQN, DQNConfig, ReplayBuffer
 from ray_tpu.rllib.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
 from ray_tpu.rllib.learner import PPOLearner, PPOLearnerConfig, compute_gae
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 
 __all__ = [
+    "DQN",
+    "DQNConfig",
     "EnvRunnerGroup",
     "PPO",
     "PPOConfig",
     "PPOLearner",
     "PPOLearnerConfig",
+    "ReplayBuffer",
     "SingleAgentEnvRunner",
     "compute_gae",
 ]

@@ -1,0 +1,236 @@
+"""DQN — the second algorithm family (off-policy, replay-buffer based).
+
+Reference parity: rllib/algorithms/dqn (new API stack): EnvRunners
+collect transitions with epsilon-greedy exploration into a replay buffer
+(utils/replay_buffers/), the learner samples minibatches and applies the
+(double-)DQN TD target with a periodically-synced target network; the
+update is one jitted SPMD step (torch variant: dqn_torch_learner.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import models
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+
+
+class ReplayBuffer:
+    """Uniform FIFO replay (reference: EpisodeReplayBuffer simplified to
+    transition granularity)."""
+
+    def __init__(self, capacity: int, obs_dim: int):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros((capacity,), np.int64)
+        self.rewards = np.zeros((capacity,), np.float32)
+        self.dones = np.zeros((capacity,), np.bool_)
+        self.size = 0
+        self.pos = 0
+
+    def add_batch(self, obs, actions, rewards, next_obs, dones):
+        n = len(actions)
+        idx = (self.pos + np.arange(n)) % self.capacity
+        self.obs[idx] = obs
+        self.actions[idx] = actions
+        self.rewards[idx] = rewards
+        self.next_obs[idx] = next_obs
+        self.dones[idx] = dones
+        self.pos = int((self.pos + n) % self.capacity)
+        self.size = int(min(self.size + n, self.capacity))
+
+    def sample(self, batch_size: int, rng: np.random.RandomState) -> dict:
+        idx = rng.randint(0, self.size, batch_size)
+        return {
+            "obs": self.obs[idx],
+            "actions": self.actions[idx],
+            "rewards": self.rewards[idx],
+            "next_obs": self.next_obs[idx],
+            "dones": self.dones[idx].astype(np.float32),
+        }
+
+
+@dataclasses.dataclass
+class DQNConfig:
+    env: str = "CartPole-v1"
+    num_env_runners: int = 0
+    num_envs_per_env_runner: int = 8
+    rollout_fragment_length: int = 16
+    gamma: float = 0.99
+    lr: float = 5e-4
+    buffer_capacity: int = 50_000
+    train_batch_size: int = 64
+    num_steps_sampled_before_learning: int = 1000
+    target_update_freq: int = 500  # learner updates between target syncs
+    updates_per_iteration: int = 32
+    double_q: bool = True
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_decay_steps: int = 10_000
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def environment(self, env: str) -> "DQNConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, **kw) -> "DQNConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def training(self, **kw) -> "DQNConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN:
+    """Epsilon-greedy sampling rides the PPO env-runner machinery: the
+    runner samples with a stochastic policy head; DQN overrides sampled
+    actions toward greedy as epsilon decays by syncing a temperature-less
+    Q-head (the categorical over Q-logits acts as exploration — with
+    epsilon mixed in on the learner-side weight sync)."""
+
+    def __init__(self, config: DQNConfig):
+        self.config = config
+        import gymnasium as gym
+
+        probe = gym.make(config.env)
+        self.obs_dim = int(np.prod(probe.observation_space.shape))
+        self.n_actions = int(probe.action_space.n)
+        probe.close()
+
+        key = jax.random.PRNGKey(config.seed)
+        self.params = models.init_mlp_policy(
+            key, self.obs_dim, self.n_actions, config.hidden)
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.tx = optax.adam(config.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.buffer = ReplayBuffer(config.buffer_capacity, self.obs_dim)
+        self._rng = np.random.RandomState(config.seed)
+        self._env_steps = 0
+        self._updates = 0
+        self._iteration = 0
+
+        self.env_runner_group = EnvRunnerGroup(
+            num_env_runners=config.num_env_runners,
+            remote=config.num_env_runners > 0,
+            env=config.env,
+            num_envs=config.num_envs_per_env_runner,
+            rollout_fragment_length=config.rollout_fragment_length,
+            seed=config.seed,
+            hidden=config.hidden,
+        )
+
+        cfg = config
+
+        def td_loss(params, target_params, batch):
+            q = models.forward(params, batch["obs"])[0]  # pi head = Q values
+            q_taken = jnp.take_along_axis(
+                q, batch["actions"][:, None], axis=1)[:, 0]
+            q_next_target = models.forward(target_params,
+                                           batch["next_obs"])[0]
+            if cfg.double_q:
+                q_next_online = models.forward(params, batch["next_obs"])[0]
+                best = jnp.argmax(q_next_online, axis=1)
+                q_next = jnp.take_along_axis(
+                    q_next_target, best[:, None], axis=1)[:, 0]
+            else:
+                q_next = jnp.max(q_next_target, axis=1)
+            target = batch["rewards"] + cfg.gamma * (1 - batch["dones"]) \
+                * q_next
+            td = q_taken - jax.lax.stop_gradient(target)
+            return jnp.mean(jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
+                                      jnp.abs(td) - 0.5))  # Huber
+
+        def update(params, opt_state, target_params, batch):
+            loss, grads = jax.value_and_grad(td_loss)(
+                params, target_params, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._update = jax.jit(update, donate_argnums=(0, 1))
+        self._sync_runner_weights()
+
+    # -- exploration -----------------------------------------------------
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._env_steps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final -
+                                             cfg.epsilon_initial)
+
+    def _sync_runner_weights(self):
+        """Scale Q-logits so the runner's categorical sampling acts
+        epsilon-greedy-ish: low epsilon -> sharp (greedy) distribution."""
+        eps = max(self._epsilon(), 1e-3)
+        sharpness = 1.0 / eps
+        w = jax.tree.map(np.asarray, self.params)
+        last = w["pi"][-1]
+        w["pi"][-1] = {"w": last["w"] * sharpness, "b": last["b"] * sharpness}
+        self.env_runner_group.sync_weights(w)
+
+    # -- training --------------------------------------------------------
+
+    def train(self) -> dict:
+        cfg = self.config
+        t0 = time.perf_counter()
+        samples = self.env_runner_group.sample()
+        ep_returns, env_steps = [], 0
+        for s in samples:
+            # transitions (o_t, a_t, r_t, o_{t+1}): the final step of a
+            # fragment has no in-fragment successor — drop it (1/T of
+            # data) rather than fabricate one
+            obs = s["obs"][:-1].reshape(-1, s["obs"].shape[-1])
+            nxt = s["obs"][1:].reshape(-1, s["obs"].shape[-1])
+            self.buffer.add_batch(obs, s["actions"][:-1].reshape(-1),
+                                  s["rewards"][:-1].reshape(-1), nxt,
+                                  s["dones"][:-1].reshape(-1))
+            env_steps += s["env_steps"]
+            if s["num_episodes"]:
+                ep_returns.append(s["episode_return_mean"])
+        self._env_steps += env_steps
+
+        losses = []
+        if self.buffer.size >= cfg.num_steps_sampled_before_learning:
+            for _ in range(cfg.updates_per_iteration):
+                batch = self.buffer.sample(cfg.train_batch_size, self._rng)
+                batch = {k: jnp.asarray(v) for k, v in batch.items()}
+                self.params, self.opt_state, loss = self._update(
+                    self.params, self.opt_state, self.target_params, batch)
+                losses.append(float(loss))
+                self._updates += 1
+                if self._updates % cfg.target_update_freq == 0:
+                    self.target_params = jax.tree.map(jnp.copy, self.params)
+        self._sync_runner_weights()
+        self._iteration += 1
+        dt = time.perf_counter() - t0
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": float(np.mean(ep_returns))
+            if ep_returns else float("nan"),
+            "num_env_steps_sampled_lifetime": self._env_steps,
+            "env_steps_per_sec": env_steps / dt,
+            "epsilon": self._epsilon(),
+            "learner/td_loss": float(np.mean(losses)) if losses
+            else float("nan"),
+            "buffer_size": self.buffer.size,
+        }
+
+    def stop(self):
+        self.env_runner_group.shutdown()

@@ -176,8 +176,69 @@ def main(argv=None):
     p.add_argument("--session-dir")
     p.set_defaults(fn=cmd_stop)
 
+    p = sub.add_parser("submit")
+    p.add_argument("--address", required=True)
+    p.add_argument("--submission-id")
+    p.add_argument("--no-wait", action="store_true")
+    p.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                   help="-- command to run")
+    p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("job")
+    p.add_argument("action", choices=["status", "logs", "stop", "list"])
+    p.add_argument("--address", required=True)
+    p.add_argument("--id")
+    p.set_defaults(fn=cmd_job)
+
     args = ap.parse_args(argv)
     return args.fn(args)
+
+
+def cmd_submit(args):
+    import ray_tpu
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    ray_tpu.init(address=args.address)
+    client = JobSubmissionClient(args.address)
+    entry = args.entrypoint
+    if entry and entry[0] == "--":
+        entry = entry[1:]
+    if not entry:
+        print("error: no entrypoint given (use: submit --address A -- cmd)",
+              file=sys.stderr)
+        return 2
+    job_id = client.submit_job(entrypoint=" ".join(entry),
+                               submission_id=args.submission_id)
+    print(f"submitted {job_id}")
+    if args.no_wait:
+        return 0
+    status = client.wait_until_finished(job_id, timeout=3600)
+    print(f"job {job_id}: {status.value}")
+    print(client.get_job_logs(job_id), end="")
+    return 0 if status.value == "SUCCEEDED" else 1
+
+
+def cmd_job(args):
+    import ray_tpu
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    ray_tpu.init(address=args.address)
+    client = JobSubmissionClient(args.address)
+    if args.action == "list":
+        for j in client.list_jobs():
+            print(f"{j.submission_id}\t{j.status.value}\t{j.entrypoint}")
+        return 0
+    if not args.id:
+        print("error: --id required", file=sys.stderr)
+        return 2
+    if args.action == "status":
+        info = client.get_job_info(args.id)
+        print(f"{info.status.value} {info.message}")
+    elif args.action == "logs":
+        print(client.get_job_logs(args.id), end="")
+    elif args.action == "stop":
+        print("stopped" if client.stop_job(args.id) else "not found")
+    return 0
 
 
 if __name__ == "__main__":

@@ -55,8 +55,10 @@ class Metric:
         self._values: dict[tuple, float] = {}
         self._lock = threading.Lock()
         registered = _registry.register(self)
-        if registered is not self:
-            # same-name re-creation shares state (reference behavior)
+        self._shared_from = registered if registered is not self else None
+        if self._shared_from is not None:
+            # same-name re-creation shares state (reference behavior);
+            # subclasses adopt their extra stores in _adopt_shared
             self._values = registered._values
             self._lock = registered._lock
 
@@ -114,9 +116,18 @@ class Histogram(Metric):
         self.boundaries = tuple(boundaries) or (
             0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10)
         super().__init__(name, description, tag_keys)
-        self._counts: dict[tuple, list[int]] = {}
-        self._sums: dict[tuple, float] = {}
-        self._totals: dict[tuple, int] = {}
+        shared = self._shared_from
+        if shared is not None and isinstance(shared, Histogram):
+            # observations must land in the registered instance's stores,
+            # or re-created histograms silently drop data from /metrics
+            self._counts = shared._counts
+            self._sums = shared._sums
+            self._totals = shared._totals
+            self.boundaries = shared.boundaries
+        else:
+            self._counts: dict[tuple, list[int]] = {}
+            self._sums: dict[tuple, float] = {}
+            self._totals: dict[tuple, int] = {}
 
     def observe(self, value: float, tags: dict | None = None):
         k = self._key(tags)

@@ -1,0 +1,53 @@
+"""Dashboard-lite endpoint tests."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import dashboard
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    port = dashboard.start_dashboard(c.address, port=0)
+    yield c, port
+    dashboard.stop_dashboard()
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=30) as r:
+        return r.read().decode()
+
+
+def test_html_page(cluster):
+    _, port = cluster
+    body = _get(port, "/")
+    assert "ray_tpu cluster" in body
+
+
+def test_api_endpoints(cluster):
+    _, port = cluster
+    s = json.loads(_get(port, "/api/state"))
+    assert s["nodes_alive"] == 1
+    nodes = json.loads(_get(port, "/api/nodes"))
+    assert nodes[0]["alive"]
+
+    @ray_tpu.remote
+    class D:
+        def p(self):
+            return 1
+
+    a = D.remote()
+    assert ray_tpu.get(a.p.remote(), timeout=60) == 1
+    actors = json.loads(_get(port, "/api/actors"))
+    assert any(x["state"] == "ALIVE" for x in actors)
+    assert "# TYPE" in _get(port, "/metrics") or _get(port, "/metrics") == "\n"

@@ -1,0 +1,53 @@
+"""Job submission tests (reference model: dashboard/modules/job/tests)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_submit_and_succeed(cluster):
+    client = JobSubmissionClient(cluster.address)
+    job_id = client.submit_job(
+        entrypoint="echo hello-from-job && python -c 'print(6*7)'")
+    status = client.wait_until_finished(job_id, timeout=120)
+    assert status == JobStatus.SUCCEEDED
+    logs = client.get_job_logs(job_id)
+    assert "hello-from-job" in logs
+    assert "42" in logs
+
+
+def test_failed_job_reports_exit_code(cluster):
+    client = JobSubmissionClient(cluster.address)
+    job_id = client.submit_job(entrypoint="python -c 'import sys; sys.exit(3)'")
+    status = client.wait_until_finished(job_id, timeout=120)
+    assert status == JobStatus.FAILED
+    assert "exit code 3" in client.get_job_info(job_id).message
+
+
+def test_list_and_stop(cluster):
+    client = JobSubmissionClient(cluster.address)
+    job_id = client.submit_job(entrypoint="sleep 60", submission_id="longjob")
+    import time
+
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if client.get_job_status(job_id) == JobStatus.RUNNING:
+            break
+        time.sleep(0.2)
+    assert client.stop_job(job_id)
+    status = client.wait_until_finished(job_id, timeout=60)
+    assert status == JobStatus.STOPPED
+    jobs = client.list_jobs()
+    assert any(j.submission_id == "longjob" for j in jobs)

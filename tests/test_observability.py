@@ -99,3 +99,12 @@ def test_cli_status_and_list(cluster):
     assert out.returncode == 0, out.stderr
     rows = json.loads(out.stdout)
     assert rows and rows[0]["alive"]
+
+
+def test_histogram_recreation_shares_state():
+    h1 = m.Histogram("shared_lat", "l", boundaries=(1.0,))
+    h1.observe(0.5)
+    h2 = m.Histogram("shared_lat", "l", boundaries=(1.0,))
+    h2.observe(0.7)  # must land in the registered instance's buckets
+    text = m.prometheus_text()
+    assert "shared_lat_count 2" in text

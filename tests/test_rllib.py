@@ -92,3 +92,40 @@ def test_ppo_distributed_env_runners(cluster):
     algo.stop()
     assert best >= 195, f"PPO (distributed) failed to learn (best {best})"
     assert max(steps_per_sec) > 100  # sanity: sampling actually parallel
+
+
+def test_dqn_learns_cartpole_inline():
+    """Second algorithm family: off-policy DQN with replay buffer +
+    target network (reference: rllib/algorithms/dqn)."""
+    from ray_tpu.rllib import DQNConfig
+
+    algo = (DQNConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                         rollout_fragment_length=32)
+            .training(updates_per_iteration=64,
+                      num_steps_sampled_before_learning=500)).build()
+    import time
+
+    t0 = time.time()
+    best = 0.0
+    while time.time() - t0 < 240:
+        r = algo.train()
+        m = r["episode_return_mean"]
+        if m == m:
+            best = max(best, m)
+        if best >= 195:
+            break
+    assert best >= 195, f"DQN failed to learn CartPole (best {best})"
+
+
+def test_replay_buffer_wraparound():
+    from ray_tpu.rllib import ReplayBuffer
+
+    buf = ReplayBuffer(10, 2)
+    obs = np.arange(16 * 2, dtype=np.float32).reshape(16, 2)
+    buf.add_batch(obs, np.arange(16), np.ones(16, np.float32), obs,
+                  np.zeros(16, bool))
+    assert buf.size == 10 and buf.pos == 6
+    s = buf.sample(32, np.random.RandomState(0))
+    assert s["obs"].shape == (32, 2)
