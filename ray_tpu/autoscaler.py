@@ -1,0 +1,179 @@
+"""Autoscaler — demand-driven node scaling over a pluggable provider.
+
+Reference parity: the StandardAutoscaler loop
+(autoscaler/_private/autoscaler.py:171) reading cluster load and asking
+a NodeProvider (autoscaler/node_provider.py ABC) to launch/terminate
+nodes; the fake multi-node provider (autoscaler/_private/fake_multi_node)
+is the no-cloud test path. Scale-up signals: queued tasks with no
+cluster-wide headroom and PENDING placement groups; scale-down: nodes
+idle (full availability, empty queue) past idle_timeout. A TPU cloud
+provider would implement NodeProvider with queued-resources / pod-slice
+creation (reference: gcp/tpu_command_runner.py) — out of scope in this
+zero-egress image."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any
+
+
+class NodeProvider:
+    """ABC (reference: autoscaler/node_provider.py)."""
+
+    def create_node(self, node_type: str) -> Any:
+        raise NotImplementedError
+
+    def terminate_node(self, handle: Any):
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> list:
+        raise NotImplementedError
+
+    def node_id(self, handle: Any) -> bytes:
+        raise NotImplementedError
+
+
+class FakeNodeProvider(NodeProvider):
+    """Launches in-process Nodelets against a head — the single-box test
+    provider (reference: fake_multi_node)."""
+
+    def __init__(self, head_address: str, node_types: dict[str, dict],
+                 session_dir: str = "/tmp/ray_tpu/autoscaler"):
+        self.head_address = head_address
+        self.node_types = node_types
+        self.session_dir = session_dir
+        self._nodes: list = []
+
+    def create_node(self, node_type: str):
+        from ray_tpu.core.nodelet import Nodelet
+
+        spec = self.node_types[node_type]
+        nl = Nodelet(self.head_address, dict(spec.get("resources", {})),
+                     labels=dict(spec.get("labels", {})),
+                     session_dir=self.session_dir,
+                     store_capacity=spec.get("store_capacity",
+                                             64 * 1024 * 1024)).start()
+        self._nodes.append(nl)
+        return nl
+
+    def terminate_node(self, handle):
+        try:
+            handle.stop()
+        finally:
+            if handle in self._nodes:
+                self._nodes.remove(handle)
+
+    def non_terminated_nodes(self) -> list:
+        return list(self._nodes)
+
+    def node_id(self, handle) -> bytes:
+        return handle.node_id
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    min_workers: int = 0
+    max_workers: int = 4
+    node_type: str = "worker"
+    idle_timeout_s: float = 30.0
+    poll_interval_s: float = 1.0
+    upscaling_speed: int = 1  # nodes added per decision
+
+
+class StandardAutoscaler:
+    def __init__(self, head_address: str, provider: NodeProvider,
+                 config: AutoscalerConfig | None = None):
+        from ray_tpu.core.rpc import RpcClient
+
+        self.head_address = head_address
+        self.provider = provider
+        self.config = config or AutoscalerConfig()
+        self.client = RpcClient.shared()
+        self._idle_since: dict[bytes, float] = {}
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="autoscaler")
+        self.num_launches = 0
+        self.num_terminations = 0
+
+    def start(self) -> "StandardAutoscaler":
+        for _ in range(self.config.min_workers):
+            self.provider.create_node(self.config.node_type)
+            self.num_launches += 1
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stopped.set()
+
+    # -- one reconciliation pass (public for deterministic tests) --------
+
+    def reconcile(self):
+        cfg = self.config
+        try:
+            view = self.client.call(self.head_address, "cluster_view", {},
+                                    timeout=10)["nodes"]
+            pgs = self.client.call(self.head_address, "pg_table", {},
+                                   timeout=10).get("groups", [])
+        except Exception:  # noqa: BLE001
+            return
+        alive = [n for n in view if n["alive"]]
+        total_queued = sum(n.get("queue_len", 0) for n in alive)
+        headroom = {}
+        for n in alive:
+            for r, q in n.get("available", {}).items():
+                headroom[r] = headroom.get(r, 0.0) + q
+        pending_pgs = any(g.get("state") == "PENDING" for g in pgs)
+        managed = self.provider.non_terminated_nodes()
+
+        # scale up: queued work with no CPU headroom, or unplaceable PGs
+        want_up = (total_queued > 0 and headroom.get("CPU", 0.0) < 1.0) \
+            or pending_pgs
+        if want_up and len(managed) < cfg.max_workers:
+            n_new = min(cfg.upscaling_speed,
+                        cfg.max_workers - len(managed))
+            for _ in range(n_new):
+                self.provider.create_node(cfg.node_type)
+                self.num_launches += 1
+            return  # let the new capacity register before judging idleness
+
+    # -- scale-down (separate so tests can drive phases) -----------------
+
+    def reconcile_down(self):
+        cfg = self.config
+        try:
+            view = self.client.call(self.head_address, "cluster_view", {},
+                                    timeout=10)["nodes"]
+        except Exception:  # noqa: BLE001
+            return
+        by_id = {n["node_id"]: n for n in view}
+        now = time.monotonic()
+        managed = self.provider.non_terminated_nodes()
+        for handle in managed:
+            if len(self.provider.non_terminated_nodes()) <= cfg.min_workers:
+                break
+            nid = self.provider.node_id(handle)
+            n = by_id.get(nid)
+            if n is None or not n["alive"]:
+                continue
+            avail = n.get("available", {})
+            total = n.get("resources", {})
+            # tolerance compare: fractional acquire/release sequences can
+            # leave 1e-16-scale residue that exact equality never matches
+            idle = (n.get("queue_len", 0) == 0 and all(
+                abs(avail.get(r, 0.0) - q) < 1e-6 for r, q in total.items()))
+            if not idle:
+                self._idle_since.pop(nid, None)
+                continue
+            t0 = self._idle_since.setdefault(nid, now)
+            if now - t0 >= cfg.idle_timeout_s:
+                self.provider.terminate_node(handle)
+                self.num_terminations += 1
+                self._idle_since.pop(nid, None)
+
+    def _loop(self):
+        while not self._stopped.wait(self.config.poll_interval_s):
+            self.reconcile()
+            self.reconcile_down()

@@ -66,6 +66,10 @@ class Head:
         self._named: dict[tuple[str, str], bytes] = {}
         self._subs: dict[str, set[str]] = {}  # topic -> subscriber addresses
         self._pgs = {}  # placement groups: pg_id -> record (see placement.py)
+        from collections import deque as _dq
+
+        self._task_events = _dq(maxlen=10000)
+        self._queue_lens: dict[bytes, int] = {}  # pending tasks per node
         self._stopped = threading.Event()
         # storage writes are queued IN LOCK ORDER and drained by one
         # writer thread: disk order then matches memory order without
@@ -94,6 +98,8 @@ class Head:
         s.register("pg_table", self._h_pg_table)
         s.register("remove_pg", self._h_remove_pg)
         s.register("list_actors", self._h_list_actors)
+        s.register("task_event", self._h_task_event, oneway=True)
+        s.register("list_tasks", self._h_list_tasks)
         s.register("ping", lambda m, f: "pong")
         self._monitor = threading.Thread(target=self._monitor_loop, daemon=True,
                                          name="head-monitor")
@@ -193,6 +199,7 @@ class Head:
             if nid in self._nodes:
                 self._last_beat[nid] = time.monotonic()
                 self._available[nid] = msg["available"]
+                self._queue_lens[nid] = msg.get("queue_len", 0)
                 self._nodes[nid].alive = True
 
     def _h_cluster_view(self, msg, frames):
@@ -207,6 +214,7 @@ class Head:
                         "labels": n.labels,
                         "store_name": n.store_name,
                         "alive": n.alive,
+                        "queue_len": self._queue_lens.get(n.node_id, 0),
                     }
                     for n in self._nodes.values()
                 ]
@@ -457,6 +465,19 @@ class Head:
         self._actor_died(rec, "killed via ray_tpu.kill()",
                          allow_restart=not no_restart)
         return {}
+
+    def _h_task_event(self, msg, frames):
+        """Executor-side task lifecycle events (reference:
+        TaskEventBuffer -> GcsTaskManager, gcs_task_manager.h:86 —
+        bounded in-memory store feeding the state API)."""
+        with self._lock:
+            self._task_events.append(msg)
+
+    def _h_list_tasks(self, msg, frames):
+        limit = int(msg.get("limit", 1000))
+        with self._lock:
+            events = list(self._task_events)[-limit:]
+        return {"tasks": events}
 
     def _h_list_actors(self, msg, frames):
         """State API source (reference: `ray list actors`,

@@ -87,6 +87,7 @@ class Nodelet:
         # _place must see them or a submission burst that outraces the
         # dispatch thread all lands locally instead of spilling
         self._queued_demand: dict[str, float] = {}
+        self._enqueue_time: dict[bytes, float] = {}  # task_id -> queued at
         self._workers: dict[bytes, _Worker] = {}
         self._idle_workers: deque[_Worker] = deque()
         self._bundles: dict[tuple, dict] = {}  # (pg_id, idx) -> reserved
@@ -184,10 +185,12 @@ class Nodelet:
         while not self._stopped.wait(HEARTBEAT_INTERVAL_S):
             with self._lock:
                 avail = dict(self._available)
+                qlen = len(self._queue)
             try:
                 self.client.send_oneway(self.head_address, "heartbeat",
                                         {"node_id": self.node_id,
-                                         "available": avail})
+                                         "available": avail,
+                                         "queue_len": qlen})
             except Exception:
                 pass
 
@@ -323,13 +326,14 @@ class Nodelet:
                         free[r] = min(cap.get(r, 0.0),
                                       free.get(r, 0.0) + q)
 
-    def _fail_task(self, spec: TaskSpec, cause: str):
+    def _fail_task(self, spec: TaskSpec, cause: str,
+                   retryable: bool = False):
         try:
             self.client.send_oneway(spec.owner, "task_done", {
                 "task_id": spec.task_id,
                 "oids": spec.return_oids,
                 "error": ser.dumps_msg(ValueError(cause)),
-                "retryable": False,
+                "retryable": retryable,
             })
         except Exception:
             pass
@@ -352,12 +356,14 @@ class Nodelet:
             with self._lock:
                 self._queue.append(spec)
                 self._add_queued_demand(spec, +1)
+                self._enqueue_time[spec.task_id] = time.monotonic()
             self._dispatch_wake.set()
             return {"queued": "local"}
         if target is None:
             with self._lock:  # queue anyway; resources may appear
                 self._queue.append(spec)
                 self._add_queued_demand(spec, +1)
+                self._enqueue_time[spec.task_id] = time.monotonic()
             self._dispatch_wake.set()
             return {"queued": "infeasible-wait"}
         # spillback (reference: normal_task_submitter.cc:451 retry at
@@ -385,19 +391,8 @@ class Nodelet:
                 spec.spillback_count >= cfg.get("MAX_SPILLBACKS"):
             return "local" if fits_total or spec.placement_group else None
         # look for a better node
-        view = self._cluster_view_cached()
-        best, best_free = None, None
-        for n in view:
-            if n["node_id"] == self.node_id or not n["alive"]:
-                continue
-            total, avail = n["resources"], n["available"]
-            if any(total.get(r, 0.0) < q for r, q in req.items()):
-                continue
-            if any(avail.get(r, 0.0) < q for r, q in req.items()):
-                continue
-            free = sum(avail.values())
-            if best_free is None or free > best_free:
-                best, best_free = n, free
+        best = self._best_fit_node(req, self._cluster_view_cached(),
+                                   exclude_node_id=self.node_id)
         if best is not None:
             return best["address"]
         return "local" if fits_total else None
@@ -423,6 +418,58 @@ class Nodelet:
                 self._queued_demand.pop(r, None)
             else:
                 self._queued_demand[r] = v
+
+    @staticmethod
+    def _best_fit_node(req: dict, view: list, exclude_node_id=None):
+        """Feasible node with the most free capacity (shared by initial
+        placement and aged-task respill)."""
+        best, best_free = None, None
+        for n in view:
+            if n["node_id"] == exclude_node_id or not n.get("alive"):
+                continue
+            total, avail = n["resources"], n["available"]
+            if any(total.get(r, 0.0) < q for r, q in req.items()):
+                continue
+            if any(avail.get(r, 0.0) < q for r, q in req.items()):
+                continue
+            free = sum(avail.values())
+            if best_free is None or free > best_free:
+                best, best_free = n, free
+        return best
+
+    def _maybe_respill_locked(self, spec: TaskSpec):
+        """A task that has waited locally while the cluster changed can
+        move to a node with free capacity (reference: queued tasks are
+        re-scheduled when the cluster resource view changes; here aged
+        head-of-queue tasks re-run best-fit). Returns a target address or
+        None. Caller holds self._lock."""
+        if spec.placement_group is not None:
+            return None
+        if spec.spillback_count >= cfg.get("MAX_SPILLBACKS"):
+            return None
+        waited = time.monotonic() - self._enqueue_time.get(
+            spec.task_id, time.monotonic())
+        if waited < 0.5:
+            return None
+        best = self._best_fit_node(
+            spec.resources, self._cluster_view,  # refreshed by dispatch
+            exclude_node_id=self.node_id)
+        return best["address"] if best else None
+
+    def _send_respill(self, spec: TaskSpec, target: str):
+        spec.spillback_count += 1
+        try:
+            self.client.call(target, "schedule_task",
+                             {"spec": dataclass_dict(spec)}, timeout=30,
+                             retries=1)
+        except Exception as e:  # noqa: BLE001
+            # The send MAY have been delivered (lost reply): requeueing
+            # locally would risk double execution outside the dedup path.
+            # Report a retryable failure instead — the owner's resubmit
+            # carries attempt+1 and flows through the dedup like any
+            # other retry.
+            self._fail_task(spec, f"respill to {target} failed: {e}",
+                            retryable=True)
 
     def _can_run(self, req: dict) -> bool:
         return all(self._available.get(r, 0.0) >= q for r, q in req.items())
@@ -484,8 +531,16 @@ class Nodelet:
         while not self._stopped.is_set():
             self._dispatch_wake.wait(timeout=0.05)
             self._dispatch_wake.clear()
+            with self._lock:
+                starved = bool(self._queue)
+            if starved:
+                # keep the cluster view fresh (TTL-limited) so aged tasks
+                # can respill to newly-added capacity; this blocks only
+                # the dispatch thread, never heartbeats
+                self._cluster_view_cached()
             while True:
                 reject = None
+                respill = None
                 with self._lock:
                     if not self._queue:
                         break
@@ -499,10 +554,17 @@ class Nodelet:
                         if bundle_key == self._BUNDLE_REJECT:
                             self._queue.popleft()
                             self._add_queued_demand(spec, -1)
+                            self._enqueue_time.pop(spec.task_id, None)
                             reject = spec
                     if reject is None:
                         if not self._can_run(req):
-                            break
+                            respill = self._maybe_respill_locked(spec)
+                            if respill is None:
+                                break
+                            self._queue.popleft()
+                            self._add_queued_demand(spec, -1)
+                            self._enqueue_time.pop(spec.task_id, None)
+                    if reject is None and respill is None:
                         needs_tpu = spec.resources.get("TPU", 0) > 0
                         from ray_tpu.core import runtime_env as _rtenv
 
@@ -552,11 +614,17 @@ class Nodelet:
                                 free[r] = free.get(r, 0.0) - q
                         self._queue.popleft()
                         self._add_queued_demand(spec, -1)
+                        self._enqueue_time.pop(spec.task_id, None)
                 if reject is not None:
                     self._fail_task(
                         reject,
                         f"task resources {reject.resources} can never fit "
                         f"its placement-group bundle reservation")
+                    continue
+                if respill is not None:
+                    threading.Thread(target=self._send_respill,
+                                     args=(spec, respill),
+                                     daemon=True).start()
                     continue
                 if w is None:
                     try:

@@ -114,9 +114,26 @@ class WorkerRuntime(ClusterRuntime):
 
     # ------------------------------------------------------------ normal tasks
 
+    def _report_task_event(self, task_id: bytes, name: str, state: str,
+                           t0: float, kind: str):
+        try:
+            self.client.send_oneway(self.head_address, "task_event", {
+                "task_id": task_id.hex(),
+                "name": name,
+                "state": state,
+                "type": kind,
+                "duration_ms": round((time.monotonic() - t0) * 1e3, 2),
+                "worker_id": self.worker_id_bytes.hex(),
+                "node_id": self.node_id.hex() if self.node_id else "",
+                "time": time.time(),
+            })
+        except Exception:
+            pass
+
     def _h_execute_task(self, msg, frames):
         spec = TaskSpec(**msg["spec"])
         self._ctx.task_id = TaskID(spec.task_id)
+        t_start = time.monotonic()
         try:
             fn = self._fetch_fn(spec.fn_id)
             a, kw = self._decode_args(spec.args, spec.kwargs)
@@ -134,11 +151,15 @@ class WorkerRuntime(ClusterRuntime):
                         f"task {spec.name} returned {len(values)} values, "
                         f"expected {n}")
             self._ship_results(spec.owner, spec.task_id, spec.return_oids, values)
+            self._report_task_event(spec.task_id, spec.name, "FINISHED",
+                                    t_start, "NORMAL_TASK")
         except Exception as e:  # noqa: BLE001
             err = exc.TaskError.from_exception(e, spec.name)
             retryable = _matches_retry(e, spec.retry_exceptions)
             self._ship_error(spec.owner, spec.task_id, spec.return_oids, err,
                              retryable)
+            self._report_task_event(spec.task_id, spec.name, "FAILED",
+                                    t_start, "NORMAL_TASK")
         finally:
             self._ctx.task_id = None
             try:
@@ -203,6 +224,7 @@ class WorkerRuntime(ClusterRuntime):
             mname = msg["method"]
             task_id = msg.get("task_id", b"")
             self._ctx.task_id = TaskID(task_id) if task_id else None
+            t_start = time.monotonic()
             try:
                 a, kw = self._decode_args(msg["args"], msg["kwargs"])
                 fn = getattr(self._actor_instance, mname)
@@ -213,10 +235,16 @@ class WorkerRuntime(ClusterRuntime):
                 n = len(oids)
                 values = [result] if n == 1 else (list(result) if n else [])
                 self._ship_results(owner, task_id, oids, values)
+                self._report_task_event(
+                    task_id, f"{type(self._actor_instance).__name__}.{mname}",
+                    "FINISHED", t_start, "ACTOR_TASK")
             except Exception as e:  # noqa: BLE001
                 err = exc.TaskError.from_exception(
                     e, f"{type(self._actor_instance).__name__}.{mname}")
                 self._ship_error(owner, task_id, oids, err)
+                self._report_task_event(
+                    task_id, f"{type(self._actor_instance).__name__}.{mname}",
+                    "FAILED", t_start, "ACTOR_TASK")
 
     def _h_exit(self, msg, frames):
         os._exit(0)

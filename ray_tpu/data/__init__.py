@@ -5,6 +5,15 @@ streaming execution with bounded in-flight work, map/map_batches/filter
 transforms, actor-pool compute, per-shard Train ingestion.
 """
 
-from ray_tpu.data.dataset import Dataset, from_items, from_numpy, range
+from ray_tpu.data.dataset import (
+    Dataset,
+    from_items,
+    from_numpy,
+    range,
+    read_csv,
+    read_json,
+    read_text,
+)
 
-__all__ = ["Dataset", "from_items", "from_numpy", "range"]
+__all__ = ["Dataset", "from_items", "from_numpy", "range",
+           "read_csv", "read_json", "read_text"]

@@ -214,6 +214,25 @@ class Dataset:
     def sum(self) -> Any:
         return sum(self.iter_rows())
 
+    def write_jsonl(self, directory: str) -> list[str]:
+        """One output file per block (reference: write_* produce one
+        file per block/task)."""
+        import json
+        import os as _os
+
+        import ray_tpu
+
+        _os.makedirs(directory, exist_ok=True)
+        paths = []
+        for i, ref in enumerate(self._execute()):
+            block = ray_tpu.get(ref, timeout=600)
+            path = _os.path.join(directory, f"part-{i:05d}.jsonl")
+            with open(path, "w") as f:
+                for row in block:
+                    f.write(json.dumps(row, default=str) + "\n")
+            paths.append(path)
+        return paths
+
     def __repr__(self):
         ops = "->".join(o.kind for o in self._ops) or "source"
         return f"Dataset(blocks={len(self._block_refs)}, plan={ops})"
@@ -230,3 +249,75 @@ def range(n: int, parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:  # noqa: 
 def from_numpy(arr: np.ndarray, parallelism: int = _DEFAULT_PARALLELISM
                ) -> Dataset:
     return Dataset.from_items(list(arr), parallelism)
+
+
+def _paths_of(paths) -> list[str]:
+    import glob as _glob
+    import os as _os
+
+    out = []
+    for p in [paths] if isinstance(paths, str) else list(paths):
+        if _os.path.isdir(p):
+            out.extend(sorted(
+                _os.path.join(p, f) for f in _os.listdir(p)
+                if _os.path.isfile(_os.path.join(p, f))))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files match {paths!r}")
+    return out
+
+
+def _read_source(paths, read_block) -> Dataset:
+    """One block per file, read INSIDE tasks (lazy/streaming — the
+    datasource pattern, data/datasource/)."""
+    import ray_tpu
+
+    refs = [ray_tpu.put([p]) for p in _paths_of(paths)]
+    return Dataset(refs, [_Op("read", read_block)])
+
+
+def read_text(paths) -> Dataset:
+    """One row per line (reference: ray.data.read_text)."""
+
+    def rd(block):
+        out = []
+        for path in block:
+            with open(path) as f:
+                out.extend(line.rstrip("\n") for line in f)
+        return out
+
+    return _read_source(paths, rd)
+
+
+def read_csv(paths) -> Dataset:
+    """Dict rows from CSV with a header (reference: ray.data.read_csv;
+    stdlib csv instead of Arrow)."""
+
+    def rd(block):
+        import csv
+
+        out = []
+        for path in block:
+            with open(path, newline="") as f:
+                out.extend(dict(r) for r in csv.DictReader(f))
+        return out
+
+    return _read_source(paths, rd)
+
+
+def read_json(paths) -> Dataset:
+    """JSONL rows (reference: ray.data.read_json)."""
+
+    def rd(block):
+        import json
+
+        out = []
+        for path in block:
+            with open(path) as f:
+                out.extend(json.loads(line) for line in f if line.strip())
+        return out
+
+    return _read_source(paths, rd)

@@ -8,6 +8,7 @@ Algorithm drivers starting with PPO (algorithms/ppo/ppo.py:389).
 """
 
 from ray_tpu.rllib.dqn import DQN, DQNConfig, ReplayBuffer
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace
 from ray_tpu.rllib.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
 from ray_tpu.rllib.learner import PPOLearner, PPOLearnerConfig, compute_gae
 from ray_tpu.rllib.ppo import PPO, PPOConfig
@@ -15,6 +16,9 @@ from ray_tpu.rllib.ppo import PPO, PPOConfig
 __all__ = [
     "DQN",
     "DQNConfig",
+    "IMPALA",
+    "IMPALAConfig",
+    "vtrace",
     "EnvRunnerGroup",
     "PPO",
     "PPOConfig",

@@ -1,0 +1,279 @@
+"""IMPALA — asynchronous sampling with a background learner thread.
+
+Reference parity: rllib/algorithms/impala (training_step :592, async
+learner wiring :1358-1370) and the MultiGPULearnerThread double-buffer
+pipeline (rllib/execution/multi_gpu_learner_thread.py:21, step :141) the
+BASELINE names explicitly. TPU shape:
+
+- env-runner actors sample continuously with slightly stale weights:
+  the driver keeps one in-flight sample() per runner and requeues it the
+  moment it lands (no sync barrier per iteration);
+- a host-side queue feeds a background LearnerThread whose update is the
+  jitted V-trace actor-critic step — the host thread keeps the jitted
+  program fed while sampling proceeds (the double-buffering role of the
+  pinned GPU stages in the reference);
+- off-policy correction: V-trace (clipped importance weights rho/c) —
+  computed host-side per batch like the GAE connector.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import models
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+
+
+def vtrace(behavior_logp, target_logp, rewards, values, dones, last_values,
+           gamma: float, rho_clip: float = 1.0, c_clip: float = 1.0):
+    """V-trace targets + pg advantages, (T, N) host arrays (Espeholt et
+    al. 2018, eq. 1)."""
+    T, N = rewards.shape
+    rho = np.minimum(np.exp(target_logp - behavior_logp), rho_clip)
+    c = np.minimum(np.exp(target_logp - behavior_logp), c_clip)
+    nonterm = 1.0 - dones.astype(np.float32)
+    next_values = np.concatenate([values[1:], last_values[None]], axis=0)
+    # bootstrap breaks at episode ends
+    delta = rho * (rewards + gamma * next_values * nonterm - values)
+    vs_minus_v = np.zeros((T + 1, N), np.float32)
+    for t in range(T - 1, -1, -1):
+        vs_minus_v[t] = delta[t] + gamma * nonterm[t] * c[t] * vs_minus_v[t + 1]
+    vs = vs_minus_v[:T] + values
+    vs_next = np.concatenate([vs[1:], last_values[None]], axis=0)
+    advantages = rho * (rewards + gamma * vs_next * nonterm - values)
+    return vs, advantages
+
+
+@dataclasses.dataclass
+class IMPALAConfig:
+    env: str = "CartPole-v1"
+    num_env_runners: int = 2
+    num_envs_per_env_runner: int = 8
+    rollout_fragment_length: int = 64
+    gamma: float = 0.99
+    lr: float = 5e-4
+    entropy_coeff: float = 0.01
+    vf_loss_coeff: float = 0.5
+    grad_clip: float = 40.0
+    queue_capacity: int = 8
+    broadcast_interval: int = 1  # learner steps between weight syncs
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def environment(self, env: str) -> "IMPALAConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, **kw) -> "IMPALAConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def training(self, **kw) -> "IMPALAConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+class _LearnerThread(threading.Thread):
+    """Background SGD (reference: LearnerThread.step,
+    execution/learner_thread.py / multi_gpu_learner_thread.py:141)."""
+
+    def __init__(self, algo: "IMPALA"):
+        super().__init__(daemon=True, name="impala-learner")
+        self.algo = algo
+        self.stopped = threading.Event()
+        self.num_updates = 0
+        self.last_loss = float("nan")
+        self.error: BaseException | None = None
+
+    def run(self):
+        algo = self.algo
+        while not self.stopped.is_set():
+            try:
+                batch = algo._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                new_params, new_opt, loss = algo._update(
+                    algo.params, algo.opt_state, batch)
+                with algo._params_lock:
+                    algo.params, algo.opt_state = new_params, new_opt
+                self.num_updates += 1
+                self.last_loss = float(loss)
+                if self.num_updates % algo.config.broadcast_interval == 0:
+                    algo._weights_dirty.set()
+            except BaseException as e:  # noqa: BLE001
+                # surface instead of dying silently: train() re-raises
+                self.error = e
+                self.stopped.set()
+                return
+
+
+class IMPALA:
+    def __init__(self, config: IMPALAConfig):
+        self.config = config
+        import gymnasium as gym
+
+        probe = gym.make(config.env)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        n_actions = int(probe.action_space.n)
+        probe.close()
+
+        self.params = models.init_mlp_policy(
+            jax.random.PRNGKey(config.seed), obs_dim, n_actions,
+            config.hidden)
+        self.tx = optax.chain(optax.clip_by_global_norm(config.grad_clip),
+                              optax.adam(config.lr))
+        self.opt_state = self.tx.init(self.params)
+        cfg = config
+
+        def loss_fn(params, batch):
+            logits, value = models.forward(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=1)[:, 0]
+            pg = -jnp.mean(logp * batch["advantages"])
+            vf = jnp.mean((value - batch["vs"]) ** 2)
+            ent = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            return pg + cfg.vf_loss_coeff * vf - cfg.entropy_coeff * ent
+
+        def update(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        # NO buffer donation: params are read concurrently by the
+        # driver thread (V-trace target logp, weight broadcast) while
+        # the learner thread updates them
+        self._update = jax.jit(update)
+        self._params_lock = threading.Lock()
+        self._logp_fn = jax.jit(
+            lambda p, obs, actions: jnp.take_along_axis(
+                jax.nn.log_softmax(models.forward(p, obs)[0]),
+                actions[:, None], axis=1)[:, 0])
+
+        self._queue: queue.Queue = queue.Queue(maxsize=config.queue_capacity)
+        self._weights_dirty = threading.Event()
+        self.env_runner_group = EnvRunnerGroup(
+            num_env_runners=config.num_env_runners,
+            remote=config.num_env_runners > 0,
+            env=config.env, num_envs=config.num_envs_per_env_runner,
+            rollout_fragment_length=config.rollout_fragment_length,
+            seed=config.seed, hidden=config.hidden)
+        self.env_runner_group.sync_weights(
+            jax.tree.map(np.asarray, self.params))
+        self.learner_thread = _LearnerThread(self)
+        self.learner_thread.start()
+        self._inflight: dict = {}
+        self._iteration = 0
+        self._env_steps = 0
+        self._ep_returns: list[float] = []
+
+    # -- async sampling plumbing ----------------------------------------
+
+    def _to_batch(self, s: dict) -> dict:
+        """Fragment -> V-trace learner batch (host-side, flattened)."""
+        cfg = self.config
+        T, N = s["rewards"].shape
+        obs_flat = s["obs"].reshape(T * N, -1).astype(np.float32)
+        with self._params_lock:
+            params = self.params
+        target_logp = np.asarray(self._logp_fn(
+            params, obs_flat, s["actions"].reshape(-1))
+        ).reshape(T, N)
+        vs, adv = vtrace(s["logp"], target_logp, s["rewards"], s["values"],
+                         s["dones"], s["last_values"], cfg.gamma)
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        return {
+            "obs": jnp.asarray(obs_flat),
+            "actions": jnp.asarray(s["actions"].reshape(-1)),
+            "vs": jnp.asarray(vs.reshape(-1)),
+            "advantages": jnp.asarray(adv.reshape(-1)),
+        }
+
+    def train(self) -> dict:
+        """One driver iteration: harvest landed samples, keep one
+        in-flight per runner, feed the learner queue (reference:
+        IMPALA.training_step's async path)."""
+        import ray_tpu
+
+        cfg = self.config
+        if self.learner_thread.error is not None:
+            raise RuntimeError(
+                "IMPALA learner thread failed") from self.learner_thread.error
+        t0 = time.perf_counter()
+        group = self.env_runner_group
+        env_steps = 0
+
+        if not group.remote:
+            # inline mode: synchronous but still through the queue+thread
+            s = group.local.sample()
+            env_steps += s["env_steps"]
+            if s["num_episodes"]:
+                self._ep_returns.append(s["episode_return_mean"])
+            self._queue.put(self._to_batch(s), timeout=30)
+        else:
+            for r in group.runners:
+                if r not in self._inflight:
+                    self._inflight[r] = r.sample.remote()
+            deadline = time.monotonic() + 5
+            harvested = 0
+            while harvested == 0 and time.monotonic() < deadline:
+                ready, _ = ray_tpu.wait(
+                    list(self._inflight.values()),
+                    num_returns=1, timeout=2.0)
+                for ref in ready:
+                    runner = next(r for r, v in self._inflight.items()
+                                  if v == ref)
+                    s = ray_tpu.get(ref, timeout=60)
+                    self._inflight[runner] = runner.sample.remote()
+                    env_steps += s["env_steps"]
+                    if s["num_episodes"]:
+                        self._ep_returns.append(s["episode_return_mean"])
+                    try:
+                        self._queue.put_nowait(self._to_batch(s))
+                    except queue.Full:
+                        pass  # backpressure: drop (reference drops too)
+                    harvested += 1
+
+        if self._weights_dirty.is_set():
+            self._weights_dirty.clear()
+            with self._params_lock:
+                params = self.params
+            group.sync_weights(jax.tree.map(np.asarray, params))
+
+        self._env_steps += env_steps
+        self._iteration += 1
+        dt = time.perf_counter() - t0
+        window = self._ep_returns[-100:]
+        self._ep_returns = window
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": float(np.mean(window)) if window
+            else float("nan"),
+            "num_env_steps_sampled_lifetime": self._env_steps,
+            "env_steps_per_sec": env_steps / dt,
+            "learner_updates": self.learner_thread.num_updates,
+            "learner/loss": self.learner_thread.last_loss,
+            "learner_queue_size": self._queue.qsize(),
+        }
+
+    def stop(self):
+        self.learner_thread.stopped.set()
+        self.env_runner_group.shutdown()

@@ -108,6 +108,8 @@ def cmd_list(args):
 
     if args.kind == "actors":
         rows = state.list_actors(address=args.address)
+    elif args.kind == "tasks":
+        rows = state.list_tasks(address=args.address)
     elif args.kind == "nodes":
         rows = state.list_nodes(address=args.address)
     elif args.kind == "pgs":
@@ -168,7 +170,7 @@ def main(argv=None):
     p.set_defaults(fn=cmd_status)
 
     p = sub.add_parser("list")
-    p.add_argument("kind", choices=["actors", "nodes", "pgs"])
+    p.add_argument("kind", choices=["actors", "nodes", "pgs", "tasks"])
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_list)
 

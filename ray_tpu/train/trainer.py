@@ -36,7 +36,11 @@ from ray_tpu.train.worker_group import WorkerGroup, WorkerGroupError
 @dataclasses.dataclass
 class ScalingConfig:
     """Reference: ray.train.ScalingConfig (air/config.py). num_workers is
-    the number of jax PROCESSES (one per host on TPU), not chips."""
+    the number of jax PROCESSES (one per host on TPU), not chips. Setting
+    min_workers turns on ELASTIC sizing (reference: Train v2
+    ScalingPolicy, v2/_internal/execution/scaling_policy/scaling_policy.py:26):
+    each gang (re)start sizes the world to what the cluster can place,
+    within [min_workers, num_workers]."""
 
     num_workers: int = 1
     use_tpu: bool = False
@@ -45,6 +49,22 @@ class ScalingConfig:
     # jax-on-CPU workers: how many virtual devices each process exposes
     # (tests / laptops; None on real TPU workers)
     num_cpu_devices_per_worker: int | None = None
+    min_workers: int | None = None  # elastic floor (None = fixed size)
+
+    def decide_num_workers(self) -> int:
+        """Elastic sizing decision against the live resource view."""
+        if self.min_workers is None:
+            return self.num_workers
+        import ray_tpu
+
+        avail = ray_tpu.available_resources()
+        req = self.worker_resources()
+        fit = self.num_workers
+        for r, q in req.items():
+            if q > 0:
+                # epsilon guards float residue from fractional releases
+                fit = min(fit, int((avail.get(r, 0.0) + 1e-9) // q))
+        return max(self.min_workers, min(self.num_workers, fit))
 
     def worker_resources(self) -> dict[str, float]:
         if self.resources_per_worker is not None:
@@ -148,15 +168,16 @@ class JaxTrainer:
     def _start_worker_group(self, name: str, exp_dir: str,
                             resume: Checkpoint | None) -> WorkerGroup:
         sc = self.scaling_config
+        n_workers = sc.decide_num_workers()
         wg = WorkerGroup(
-            num_workers=sc.num_workers,
+            num_workers=n_workers,
             resources_per_worker=sc.worker_resources(),
             placement_strategy=sc.placement_strategy,
         )
         try:
             infos = wg.execute("node_info")
             coordinator = None
-            if sc.num_workers > 1:
+            if wg.num_workers > 1:
                 coordinator = f"{infos[0]['ip']}:{infos[0]['port']}"
             # rank/world env (reference: _create_rank_world_size_mappings,
             # backend_executor.py:376) + local ranks grouped by node
@@ -169,7 +190,7 @@ class JaxTrainer:
                 node_id = info["node_id"]
                 env = {
                     "RAY_TPU_TRAIN_RANK": rank,
-                    "RAY_TPU_TRAIN_WORLD_SIZE": sc.num_workers,
+                    "RAY_TPU_TRAIN_WORLD_SIZE": wg.num_workers,
                     "RAY_TPU_TRAIN_LOCAL_RANK": by_node[node_id].index(rank),
                     "RAY_TPU_TRAIN_NODE_RANK": node_order.index(node_id),
                 }
@@ -184,7 +205,7 @@ class JaxTrainer:
 
             refs = [
                 getattr(w, "setup_jax").remote(
-                    coordinator, sc.num_workers, rank,
+                    coordinator, wg.num_workers, rank,
                     sc.num_cpu_devices_per_worker)
                 for rank, w in enumerate(wg.workers)
             ]
@@ -193,7 +214,7 @@ class JaxTrainer:
             for rank, info in enumerate(infos):
                 node_id = info["node_id"]
                 ctx = dict(
-                    world_size=sc.num_workers,
+                    world_size=wg.num_workers,
                     world_rank=rank,
                     local_rank=by_node[node_id].index(rank),
                     local_world_size=len(by_node[node_id]),

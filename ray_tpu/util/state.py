@@ -42,6 +42,13 @@ def list_nodes(address: str | None = None) -> list[dict]:
     ]
 
 
+def list_tasks(address: str | None = None, limit: int = 1000) -> list[dict]:
+    """Executor-reported task events (reference: `ray list tasks` over
+    GcsTaskManager task events)."""
+    return _head_call("list_tasks", {"limit": limit},
+                      address=address)["tasks"]
+
+
 def list_placement_groups(address: str | None = None) -> list[dict]:
     return _head_call("pg_table", address=address).get("groups", [])
 

@@ -1,0 +1,82 @@
+"""Autoscaler tests with the fake provider (reference model:
+autoscaler e2e over fake_multi_node — no cloud)."""
+
+import sys
+import time
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    AutoscalerConfig,
+    FakeNodeProvider,
+    StandardAutoscaler,
+)
+from ray_tpu.cluster_utils import Cluster
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_scale_up_on_queued_demand_and_down_when_idle(cluster, tmp_path):
+    provider = FakeNodeProvider(
+        cluster.address,
+        {"worker": {"resources": {"CPU": 4.0}}},
+        session_dir=str(tmp_path / "as"))
+    scaler = StandardAutoscaler(
+        cluster.address, provider,
+        AutoscalerConfig(min_workers=0, max_workers=2,
+                         idle_timeout_s=2.0, poll_interval_s=0.5))
+
+    @ray_tpu.remote(num_cpus=1)
+    def slow():
+        time.sleep(1.0)
+        return ray_tpu.get_runtime_context().node_id.hex()
+
+    # 6 one-CPU tasks against a 1-CPU cluster: queue builds up
+    refs = [slow.remote() for _ in range(6)]
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not provider.non_terminated_nodes():
+        scaler.reconcile()
+        time.sleep(0.3)
+    assert provider.non_terminated_nodes(), "no scale-up despite queue"
+    assert scaler.num_launches >= 1
+
+    nodes = set(ray_tpu.get(refs, timeout=120))
+    assert len(nodes) >= 2  # new capacity actually ran work
+
+    # drain, then idle nodes are reclaimed after the timeout
+    deadline = time.monotonic() + 40
+    while time.monotonic() < deadline and provider.non_terminated_nodes():
+        scaler.reconcile_down()
+        time.sleep(0.5)
+    assert not provider.non_terminated_nodes(), "idle node never reclaimed"
+    assert scaler.num_terminations >= 1
+
+
+def test_min_workers_kept(cluster, tmp_path):
+    provider = FakeNodeProvider(
+        cluster.address, {"worker": {"resources": {"CPU": 2.0}}},
+        session_dir=str(tmp_path / "as2"))
+    scaler = StandardAutoscaler(
+        cluster.address, provider,
+        AutoscalerConfig(min_workers=1, max_workers=3, idle_timeout_s=0.5))
+    scaler.start()
+    try:
+        assert len(provider.non_terminated_nodes()) == 1
+        time.sleep(2.5)  # well past idle timeout
+        assert len(provider.non_terminated_nodes()) == 1  # floor holds
+    finally:
+        scaler.stop()
+        for h in provider.non_terminated_nodes():
+            provider.terminate_node(h)

@@ -88,3 +88,22 @@ def test_repartition_and_materialize(cluster):
 def test_take_streams_lazily(cluster):
     ds = rd.range(1000, parallelism=16).map(lambda x: x)
     assert len(ds.take(5)) == 5
+
+
+def test_read_text_and_write_jsonl(cluster, tmp_path):
+    for i in range(3):
+        (tmp_path / f"f{i}.txt").write_text(f"line-{i}a\nline-{i}b\n")
+    ds = rd.read_text(str(tmp_path / "*.txt"))
+    rows = sorted(ds.take_all())
+    assert rows == sorted(f"line-{i}{s}" for i in range(3) for s in "ab")
+    out = ds.map(lambda line: {"text": line}).write_jsonl(
+        str(tmp_path / "out"))
+    assert len(out) == 3
+    back = rd.read_json(str(tmp_path / "out")).take_all()
+    assert sorted(r["text"] for r in back) == rows
+
+
+def test_read_csv(cluster, tmp_path):
+    (tmp_path / "d.csv").write_text("a,b\n1,x\n2,y\n")
+    rows = rd.read_csv(str(tmp_path / "d.csv")).take_all()
+    assert rows == [{"a": "1", "b": "x"}, {"a": "2", "b": "y"}]

@@ -108,3 +108,31 @@ def test_histogram_recreation_shares_state():
     h2.observe(0.7)  # must land in the registered instance's buckets
     text = m.prometheus_text()
     assert "shared_lat_count 2" in text
+
+
+def test_list_tasks_state_api(cluster):
+    @ray_tpu.remote(num_cpus=0.1)
+    def traced(x):
+        if x == 3:
+            raise ValueError("boom")
+        return x
+
+    refs = [traced.remote(i) for i in range(4)]
+    for i, r in enumerate(refs):
+        try:
+            ray_tpu.get(r, timeout=60)
+        except Exception:
+            assert i == 3
+    import time as _t
+
+    deadline = _t.monotonic() + 20
+    while _t.monotonic() < deadline:
+        tasks = state.list_tasks()
+        finished = [t for t in tasks if t["name"] == "traced"]
+        if len(finished) >= 4:
+            break
+        _t.sleep(0.2)
+    states = sorted(t["state"] for t in finished)
+    assert states.count("FINISHED") == 3
+    assert states.count("FAILED") == 1
+    assert all(t["duration_ms"] >= 0 for t in finished)

@@ -254,3 +254,38 @@ def test_failure_budget_exhausted(cluster, tmp_path):
     )
     with pytest.raises(TrainingFailedError):
         trainer.fit()
+
+
+def test_elastic_scaling_sizes_to_available(cluster, tmp_path):
+    """min_workers turns on elastic sizing: ask for 6, floor 1, on an
+    8-CPU cluster with 1-CPU workers the gang sizes to what fits
+    (reference: Train v2 ScalingPolicy)."""
+
+    def loop(config):
+        import ray_tpu.train as train
+
+        ctx = train.get_context()
+        train.report({"world": ctx.get_world_size()})
+
+    # occupy some CPUs so fewer than 6 fit
+    @ray_tpu.remote(num_cpus=1)
+    class Hog:
+        def ping(self):
+            return "ok"
+
+    hogs = [Hog.remote() for _ in range(4)]
+    for h in hogs:
+        assert ray_tpu.get(h.ping.remote(), timeout=60) == "ok"
+    import time
+
+    time.sleep(1.2)  # heartbeat settles
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=6, min_workers=1),
+        run_config=RunConfig(name="elastic", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    for h in hogs:
+        ray_tpu.kill(h)
+    assert 1 <= result.metrics["world"] <= 4
