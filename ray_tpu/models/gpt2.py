@@ -58,6 +58,18 @@ class GPT2Config:
         return GPT2Config()
 
     @staticmethod
+    def medium() -> "GPT2Config":
+        return GPT2Config(n_layer=24, n_head=16, n_embd=1024)
+
+    @staticmethod
+    def large() -> "GPT2Config":
+        return GPT2Config(n_layer=36, n_head=20, n_embd=1280)
+
+    @staticmethod
+    def xl() -> "GPT2Config":
+        return GPT2Config(n_layer=48, n_head=25, n_embd=1600)
+
+    @staticmethod
     def tiny(vocab_size: int = 512, block_size: int = 128) -> "GPT2Config":
         return GPT2Config(
             vocab_size=vocab_size,

@@ -38,7 +38,8 @@ class PPOConfig:
     minibatch_size: int = 128
     hidden: tuple = (64, 64)
     seed: int = 0
-    learner_mesh: Any = None  # jax Mesh for the SPMD learner update
+    num_learners: int = 0  # >1: learner mesh of that many devices
+    learner_mesh: Any = None  # or pass an explicit jax Mesh
 
     def environment(self, env: str) -> "PPOConfig":
         self.env = env
@@ -55,6 +56,34 @@ class PPOConfig:
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
         return self
+
+    def learners(self, num_learners: int = 0) -> "PPOConfig":
+        """num_learners>1 maps to a LEARNER MESH of that many devices
+        for the one jitted SPMD update (the reference spawns N NCCL
+        learner actors via Train's BackendExecutor, learner_group.py:134;
+        here GSPMD shards the minibatch over the mesh's data axis and
+        inserts the gradient psum DDP would do by hand). The mesh itself
+        is built at build() time so the config stays pure picklable data
+        and never initializes the jax backend early."""
+        self.num_learners = int(num_learners)
+        self.learner_mesh = None  # (re)derived at build()
+        return self
+
+    def _resolve_learner_mesh(self):
+        if self.learner_mesh is not None:
+            return self.learner_mesh
+        if self.num_learners <= 1:
+            return None
+        import jax
+
+        from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+
+        devices = jax.devices()[:self.num_learners]
+        if len(devices) < self.num_learners:
+            raise ValueError(
+                f"num_learners={self.num_learners} > {len(jax.devices())} "
+                f"devices")
+        return build_mesh(MeshSpec(data=self.num_learners), devices=devices)
 
     def training(self, **kwargs) -> "PPOConfig":
         for k, v in kwargs.items():
@@ -97,7 +126,7 @@ class PPO:
                 num_sgd_iter=config.num_sgd_iter,
                 minibatch_size=config.minibatch_size,
                 hidden=config.hidden),
-            mesh=config.learner_mesh, seed=config.seed)
+            mesh=config._resolve_learner_mesh(), seed=config.seed)
         self.env_runner_group.sync_weights(self.learner.get_weights())
         self._iteration = 0
         self._env_steps_total = 0

@@ -194,6 +194,20 @@ class JaxTrainer:
                     "RAY_TPU_TRAIN_LOCAL_RANK": by_node[node_id].index(rank),
                     "RAY_TPU_TRAIN_NODE_RANK": node_order.index(node_id),
                 }
+                if sc.use_tpu:
+                    # libtpu multi-host topology env (reference:
+                    # TPUAcceleratorManager worker-id/hostnames wiring,
+                    # _private/accelerators/tpu.py:157-170). Per HOST,
+                    # not per worker: multiple train workers can share a
+                    # TPU host.
+                    node_ips = []
+                    seen = set()
+                    for i in infos:
+                        if i["node_id"] not in seen:
+                            seen.add(i["node_id"])
+                            node_ips.append(i["ip"])
+                    env["TPU_WORKER_ID"] = node_order.index(node_id)
+                    env["TPU_WORKER_HOSTNAMES"] = ",".join(node_ips)
                 if coordinator:
                     env["RAY_TPU_TRAIN_COORDINATOR"] = coordinator
                 env_refs.append((rank, env))

@@ -104,3 +104,19 @@ def test_param_count_gpt2_small():
     cfg = GPT2Config.small()
     n = count_params(init_gpt2(jax.random.PRNGKey(0), cfg))
     assert 124e6 < n < 126e6
+
+
+def test_gpt2_size_presets():
+    """Config presets cover the published GPT-2 family (the reference's
+    flagship Train benchmark names GPT-2; sizes beyond small matter for
+    multi-chip sharding)."""
+    from ray_tpu.models.gpt2 import GPT2Config
+
+    for cfg, params_m in ((GPT2Config.small(), 124), (GPT2Config.medium(), 355),
+                          (GPT2Config.large(), 774), (GPT2Config.xl(), 1558)):
+        # parameter-count sanity within 5% of the published sizes
+        E, L, V = cfg.n_embd, cfg.n_layer, cfg.padded_vocab
+        approx = V * E + cfg.block_size * E + L * 12 * E * E
+        assert abs(approx / 1e6 - params_m) / params_m < 0.06, (
+            cfg, approx / 1e6)
+        assert cfg.n_embd % cfg.n_head == 0
