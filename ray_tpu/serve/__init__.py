@@ -5,12 +5,14 @@ classes become groups of replica actors managed by a controller actor
 (_private/controller.py:84); requests route through a DeploymentHandle
 with least-queue replica choice (power-of-two-choices router,
 _private/router.py:318); an optional HTTP proxy exposes apps over REST
-(_private/proxy.py). Scoped to the serving core: deployments, replicas,
-handles, routing, HTTP ingress; autoscaling/app-graphs are future work.
+(_private/proxy.py); load-driven replica autoscaling tracks mean
+ongoing requests (autoscaling_state.py). App graphs/deployment
+composition are future work.
 """
 
 from ray_tpu.serve.api import (
     Application,
+    AutoscalingConfig,
     Deployment,
     DeploymentHandle,
     delete,
@@ -22,6 +24,7 @@ from ray_tpu.serve.api import (
 
 __all__ = [
     "Application",
+    "AutoscalingConfig",
     "Deployment",
     "DeploymentHandle",
     "delete",

@@ -13,6 +13,18 @@ _CONTROLLER_NAME = "__serve_controller"
 
 
 @dataclasses.dataclass
+class AutoscalingConfig:
+    """Reference: serve autoscaling (_private/autoscaling_state.py) —
+    replica count tracks mean ongoing requests per replica."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    interval_s: float = 0.5
+    downscale_idle_rounds: int = 4  # consecutive idle polls before -1
+
+
+@dataclasses.dataclass
 class Deployment:
     """Produced by @serve.deployment; `.bind(*args)` freezes init args
     into an Application (reference: serve/deployment.py:64)."""
@@ -22,6 +34,14 @@ class Deployment:
     num_replicas: int = 1
     ray_actor_options: dict | None = None
     max_ongoing_requests: int = 16
+    autoscaling_config: AutoscalingConfig | None = None
+
+    def __post_init__(self):
+        # options(autoscaling_config={...}) goes through replace() and
+        # lands here too — normalize dicts in one place
+        if isinstance(self.autoscaling_config, dict):
+            self.autoscaling_config = AutoscalingConfig(
+                **self.autoscaling_config)
 
     def bind(self, *args, **kwargs) -> "Application":
         return Application(self, args, kwargs)
@@ -39,12 +59,14 @@ class Application:
 
 def deployment(_cls=None, *, name: str | None = None, num_replicas: int = 1,
                ray_actor_options: dict | None = None,
-               max_ongoing_requests: int = 16):
+               max_ongoing_requests: int = 16,
+               autoscaling_config: AutoscalingConfig | dict | None = None):
     def wrap(cls):
         return Deployment(cls, name or cls.__name__,
                           num_replicas=num_replicas,
                           ray_actor_options=ray_actor_options,
-                          max_ongoing_requests=max_ongoing_requests)
+                          max_ongoing_requests=max_ongoing_requests,
+                          autoscaling_config=autoscaling_config)
 
     return wrap(_cls) if _cls is not None else wrap
 
@@ -83,35 +105,126 @@ class _Replica:
 
 class ServeController:
     """Controller actor: owns the deployment -> replica-handles table and
-    reconciles replica counts (reference: _private/controller.py:84,
-    DeploymentStateManager)."""
+    reconciles replica counts, including load-driven autoscaling
+    (reference: _private/controller.py:84, DeploymentStateManager,
+    autoscaling_state.py)."""
 
     def __init__(self):
-        self._apps: dict[str, dict] = {}  # app -> {replicas, deployment meta}
+        self._apps: dict[str, dict] = {}  # app -> {replicas, meta}
+        self._scaler_started = False
+
+    def _make_replica(self, app: dict):
+        import ray_tpu
+
+        opts = dict(app["actor_options"] or {})
+        opts.setdefault("num_cpus", 0.1)
+        cls = ray_tpu.remote(**opts)(_Replica)
+        return cls.options(
+            max_concurrency=max(2, app["max_concurrency"])).remote(
+            app["cls_blob"], app["init_args"], app["init_kwargs"])
 
     def deploy(self, app_name: str, cls_blob: bytes, num_replicas: int,
                actor_options: dict | None, init_args, init_kwargs,
-               max_concurrency: int):
+               max_concurrency: int, autoscaling: dict | None = None):
         import ray_tpu
 
         self.delete(app_name)
-        opts = dict(actor_options or {})
-        opts.setdefault("num_cpus", 0.1)
-        cls = ray_tpu.remote(**opts)(_Replica)
-        replicas = [
-            cls.options(max_concurrency=max(2, max_concurrency)).remote(
-                cls_blob, init_args, init_kwargs)
-            for _ in range(num_replicas)
-        ]
+        app = {"cls_blob": cls_blob, "actor_options": actor_options,
+               "init_args": init_args, "init_kwargs": init_kwargs,
+               "max_concurrency": max_concurrency,
+               "autoscaling": autoscaling, "idle_rounds": 0,
+               "version": 0}
+        if autoscaling:
+            num_replicas = max(autoscaling["min_replicas"],
+                               min(num_replicas,
+                                   autoscaling["max_replicas"]))
+        replicas = [self._make_replica(app) for _ in range(num_replicas)]
         # readiness barrier: every replica constructed
         ray_tpu.get([r.ping.remote() for r in replicas], timeout=120)
-        self._apps[app_name] = {"replicas": replicas,
-                                "num_replicas": num_replicas}
+        app["replicas"] = replicas
+        app["num_replicas"] = num_replicas
+        self._apps[app_name] = app
+        if autoscaling and not self._scaler_started:
+            self._scaler_started = True
+            threading.Thread(target=self._autoscale_loop, daemon=True,
+                             name="serve-autoscaler").start()
         return True
+
+    def _autoscale_loop(self):
+        import time as _t
+
+        import ray_tpu
+
+        while True:
+            interval = 0.5
+            for name, app in list(self._apps.items()):
+                cfg = app.get("autoscaling")
+                if not cfg:
+                    continue
+                interval = min(interval, cfg.get("interval_s", 0.5))
+                replicas = app["replicas"]
+                try:
+                    loads = ray_tpu.get(
+                        [r.ongoing.remote() for r in replicas], timeout=10)
+                except Exception:  # noqa: BLE001
+                    continue
+                mean = sum(loads) / max(1, len(loads))
+                if mean > cfg["target_ongoing_requests"] and \
+                        len(replicas) < cfg["max_replicas"]:
+                    new = self._make_replica(app)
+                    try:
+                        ray_tpu.get(new.ping.remote(), timeout=60)
+                        replicas.append(new)
+                        app["num_replicas"] = len(replicas)
+                        app["version"] += 1
+                        app["idle_rounds"] = 0
+                    except Exception:  # noqa: BLE001
+                        pass
+                elif mean < cfg["target_ongoing_requests"] / 2 and \
+                        len(replicas) > cfg["min_replicas"]:
+                    app["idle_rounds"] += 1
+                    if app["idle_rounds"] >= cfg["downscale_idle_rounds"]:
+                        app["idle_rounds"] = 0
+                        victim = replicas.pop()
+                        app["num_replicas"] = len(replicas)
+                        app["version"] += 1
+                        threading.Thread(
+                            target=self._drain_and_kill, args=(victim,),
+                            daemon=True).start()
+                else:
+                    app["idle_rounds"] = 0
+            _t.sleep(interval)
+
+    @staticmethod
+    def _drain_and_kill(replica, timeout: float = 60.0):
+        """Downscale drains: the replica left the routing set, but
+        handles refresh lazily and in-flight work must finish — wait for
+        the refresh window plus ongoing==0 before killing (reference:
+        graceful replica shutdown, _private/replica.py)."""
+        import time as _t
+
+        import ray_tpu
+
+        _t.sleep(DeploymentHandle._REFRESH_S + 0.5)
+        deadline = _t.monotonic() + timeout
+        while _t.monotonic() < deadline:
+            try:
+                if ray_tpu.get(replica.ongoing.remote(), timeout=10) == 0:
+                    break
+            except Exception:  # noqa: BLE001
+                break
+            _t.sleep(0.2)
+        try:
+            ray_tpu.kill(replica)
+        except Exception:  # noqa: BLE001
+            pass
 
     def get_replicas(self, app_name: str):
         app = self._apps.get(app_name)
-        return list(app["replicas"]) if app else []
+        if not app:
+            return {"replicas": [], "version": -1}
+        return {"replicas": list(app["replicas"]),
+                "version": app.get("version", 0)}
 
     def list_apps(self):
         return {k: v["num_replicas"] for k, v in self._apps.items()}
@@ -139,19 +252,47 @@ class DeploymentHandle:
     """Client-side router (reference: DeploymentHandle + the
     power-of-two-choices replica scheduler, _private/router.py:318 —
     here: sample two replicas, pick the one with fewer ongoing
-    requests; falls back to round-robin when probing fails)."""
+    requests; falls back to round-robin when probing fails). The replica
+    list refreshes periodically so autoscaled replicas join/leave the
+    routing set (reference: long-poll config push)."""
+
+    _REFRESH_S = 2.0
 
     def __init__(self, app_name: str, replicas: list):
         self.app_name = app_name
         self._replicas = replicas
         self._rr = 0
+        self._version = 0
         self._lock = threading.Lock()
+        import time as _t
+
+        self._fetched = _t.monotonic()
+
+    def _maybe_refresh(self):
+        import time as _t
+
+        if _t.monotonic() - self._fetched < self._REFRESH_S:
+            return
+        try:
+            import ray_tpu
+
+            ctrl = _controller()
+            r = ray_tpu.get(ctrl.get_replicas.remote(self.app_name),
+                            timeout=10)
+            if r["replicas"] and r["version"] != self._version:
+                with self._lock:
+                    self._replicas = r["replicas"]
+                    self._version = r["version"]
+        except Exception:  # noqa: BLE001
+            pass
+        self._fetched = _t.monotonic()
 
     def _pick(self):
         import random
 
         import ray_tpu
 
+        self._maybe_refresh()
         if len(self._replicas) == 1:
             return self._replicas[0]
         a, b = random.sample(self._replicas, 2)
@@ -192,9 +333,12 @@ def run(app: Application, *, name: str = "default",
     ctrl = _controller()
     dep = app.deployment
     blob = cloudpickle.dumps(dep.cls_or_fn)
+    autoscaling = (dataclasses.asdict(dep.autoscaling_config)
+                   if dep.autoscaling_config else None)
     ray_tpu.get(ctrl.deploy.remote(
         name, blob, dep.num_replicas, dep.ray_actor_options,
-        app.init_args, app.init_kwargs, dep.max_ongoing_requests),
+        app.init_args, app.init_kwargs, dep.max_ongoing_requests,
+        autoscaling),
         timeout=180)
     handle = get_app_handle(name)
     if http_port is not None:
@@ -206,10 +350,10 @@ def get_app_handle(name: str = "default") -> DeploymentHandle:
     import ray_tpu
 
     ctrl = _controller()
-    replicas = ray_tpu.get(ctrl.get_replicas.remote(name), timeout=60)
-    if not replicas:
+    r = ray_tpu.get(ctrl.get_replicas.remote(name), timeout=60)
+    if not r["replicas"]:
         raise ValueError(f"no serve application named {name!r}")
-    return DeploymentHandle(name, replicas)
+    return DeploymentHandle(name, r["replicas"])
 
 
 def delete(name: str = "default"):

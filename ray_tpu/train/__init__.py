@@ -14,7 +14,12 @@ from ray_tpu.train.checkpoint import (
     CheckpointConfig,
     CheckpointManager,
 )
-from ray_tpu.train.session import get_checkpoint, get_context, report
+from ray_tpu.train.session import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
 from ray_tpu.train.spmd import TrainState, batch_shardings, make_train_step
 from ray_tpu.train.trainer import (
     FailureConfig,
@@ -39,6 +44,7 @@ __all__ = [
     "batch_shardings",
     "get_checkpoint",
     "get_context",
+    "get_dataset_shard",
     "make_train_step",
     "report",
 ]

@@ -64,9 +64,11 @@ class _Report:
 
 class TrainSession:
     def __init__(self, context: TrainContext,
-                 resume_checkpoint: Checkpoint | None = None):
+                 resume_checkpoint: Checkpoint | None = None,
+                 dataset_shards: dict | None = None):
         self.context = context
         self.resume_checkpoint = resume_checkpoint
+        self.dataset_shards = dataset_shards or {}
         # maxsize=1: report() blocks until the driver drains the previous
         # round — workers advance in lockstep with the driver loop
         self.results: queue.Queue[_Report] = queue.Queue(maxsize=1)
@@ -89,10 +91,11 @@ class TrainSession:
 
 
 def init_session(context: TrainContext,
-                 resume_checkpoint: Checkpoint | None = None) -> TrainSession:
+                 resume_checkpoint: Checkpoint | None = None,
+                 dataset_shards: dict | None = None) -> TrainSession:
     global _session
     with _session_lock:
-        _session = TrainSession(context, resume_checkpoint)
+        _session = TrainSession(context, resume_checkpoint, dataset_shards)
         return _session
 
 
@@ -127,3 +130,18 @@ def get_checkpoint() -> Checkpoint | None:
     """The checkpoint to resume from, if the run was restored."""
     s = get_session()
     return s.resume_checkpoint if s else None
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's shard of a Dataset passed to JaxTrainer(datasets=...)
+    (reference: ray.train.get_dataset_shard — the prepare_data_loader
+    role: per-worker streaming ingestion)."""
+    s = get_session()
+    if s is None:
+        raise RuntimeError("get_dataset_shard() outside a train worker")
+    shard = s.dataset_shards.get(name)
+    if shard is None:
+        raise KeyError(
+            f"no dataset {name!r} was passed to the trainer "
+            f"(have: {sorted(s.dataset_shards)})")
+    return shard

@@ -120,12 +120,16 @@ class JaxTrainer:
         scaling_config: ScalingConfig | None = None,
         run_config: RunConfig | None = None,
         resume_from_checkpoint: Checkpoint | None = None,
+        datasets: dict | None = None,
     ):
         self._fn = train_loop_per_worker
         self._config = train_loop_config
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self._resume = resume_from_checkpoint
+        # name -> ray_tpu.data.Dataset, split across the gang at start
+        # (reference: DataParallelTrainer datasets= + get_dataset_shard)
+        self._datasets = datasets or {}
 
     # ------------------------------------------------------------------
 
@@ -185,6 +189,12 @@ class JaxTrainer:
             for rank, info in enumerate(infos):
                 by_node.setdefault(info["node_id"], []).append(rank)
             node_order = list(by_node)
+            node_ips = []
+            _seen_nodes = set()
+            for i in infos:
+                if i["node_id"] not in _seen_nodes:
+                    _seen_nodes.add(i["node_id"])
+                    node_ips.append(i["ip"])
             env_refs = []
             for rank, info in enumerate(infos):
                 node_id = info["node_id"]
@@ -200,12 +210,6 @@ class JaxTrainer:
                     # _private/accelerators/tpu.py:157-170). Per HOST,
                     # not per worker: multiple train workers can share a
                     # TPU host.
-                    node_ips = []
-                    seen = set()
-                    for i in infos:
-                        if i["node_id"] not in seen:
-                            seen.add(i["node_id"])
-                            node_ips.append(i["ip"])
                     env["TPU_WORKER_ID"] = node_order.index(node_id)
                     env["TPU_WORKER_HOSTNAMES"] = ",".join(node_ips)
                 if coordinator:
@@ -237,9 +241,14 @@ class JaxTrainer:
                     trial_dir=exp_dir,
                     coordinator_address=coordinator,
                 )
+                shards_blob = None
+                if self._datasets:
+                    shards_blob = cloudpickle.dumps({
+                        dname: ds.shard(wg.num_workers, rank)
+                        for dname, ds in self._datasets.items()})
                 wg.execute_single(
                     rank, "start_training", fn_blob, self._config, ctx,
-                    resume.path if resume else None)
+                    resume.path if resume else None, shards_blob)
             del device_counts
             return wg
         except Exception as e:

@@ -82,14 +82,17 @@ class TrainWorker:
     # -- training --------------------------------------------------------
 
     def start_training(self, fn_blob: bytes, train_loop_config: dict | None,
-                       ctx: dict, resume_dir: str | None) -> bool:
+                       ctx: dict, resume_dir: str | None,
+                       dataset_shards_blob: bytes | None = None) -> bool:
         from ray_tpu.train import session as S
         from ray_tpu.train.checkpoint import Checkpoint
 
         fn = cloudpickle.loads(fn_blob)
+        shards = (cloudpickle.loads(dataset_shards_blob)
+                  if dataset_shards_blob else None)
         context = S.TrainContext(**ctx)
         resume = Checkpoint(resume_dir) if resume_dir else None
-        self.session = S.init_session(context, resume)
+        self.session = S.init_session(context, resume, shards)
 
         def run():
             try:

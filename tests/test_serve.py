@@ -104,3 +104,58 @@ def test_http_ingress(cluster):
         body = json.loads(resp.read())
     assert body["result"]["echo"] == {"msg": "hi"}
     serve.delete("echo")
+
+
+def test_autoscaling_up_and_down(cluster):
+    """Replica count tracks load (reference: serve autoscaling on mean
+    ongoing requests) and the handle's routing set refreshes."""
+    @serve.deployment(
+        num_replicas=1,
+        max_ongoing_requests=32,
+        autoscaling_config={"min_replicas": 1, "max_replicas": 3,
+                            "target_ongoing_requests": 2.0,
+                            "downscale_idle_rounds": 2})
+    class Slow:
+        def __call__(self, _):
+            import time as _t
+
+            _t.sleep(0.4)
+            return "ok"
+
+    handle = serve.run(Slow.bind(), name="auto")
+    import time
+
+    ctrl = ray_tpu.get_actor("__serve_controller")
+
+    def replica_count():
+        return len(ray_tpu.get(ctrl.get_replicas.remote("auto"),
+                               timeout=30)["replicas"])
+
+    assert replica_count() == 1
+    # sustained burst: keep ~12 requests in flight for a few seconds
+    refs = []
+    deadline = time.monotonic() + 15
+    grew = False
+    while time.monotonic() < deadline:
+        refs = [r for r in refs
+                if not ray_tpu.wait([r], timeout=0)[0]]
+        while len(refs) < 12:
+            refs.append(handle.remote(None))
+        if replica_count() >= 2:
+            grew = True
+            break
+        time.sleep(0.2)
+    assert grew, "autoscaler never added a replica under load"
+    for r in refs:
+        try:
+            ray_tpu.get(r, timeout=60)
+        except Exception:
+            pass
+    # idle: scales back toward min
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if replica_count() == 1:
+            break
+        time.sleep(0.5)
+    assert replica_count() == 1
+    serve.delete("auto")

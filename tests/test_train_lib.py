@@ -289,3 +289,39 @@ def test_elastic_scaling_sizes_to_available(cluster, tmp_path):
     for h in hogs:
         ray_tpu.kill(h)
     assert 1 <= result.metrics["world"] <= 4
+
+
+def test_dataset_ingestion_sharded(cluster, tmp_path):
+    """JaxTrainer(datasets=...) ships per-worker Dataset shards;
+    get_dataset_shard() streams them (reference: ray.train dataset
+    ingestion via get_dataset_shard)."""
+    from ray_tpu import data as rd
+
+    ds = rd.range(64, parallelism=8).map(lambda x: x * 2)
+
+    def loop(config):
+        import numpy as np
+
+        import ray_tpu.train as train
+
+        shard = train.get_dataset_shard("train")
+        total, count = 0, 0
+        for batch in shard.iter_batches(batch_size=8):
+            total += int(np.sum(batch))
+            count += len(batch)
+        train.report({"total": total, "count": count})
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="ingest", storage_path=str(tmp_path)),
+        datasets={"train": ds},
+    )
+    result = trainer.fit()
+    # rank0's shard: blocks 0,2,4,6 of range(64)*2
+    assert result.metrics["count"] == 32
+    history_total = result.metrics["total"]
+    expected_rank0 = sum(
+        x * 2 for i in range(0, 8, 2) for x in range(i * 8, (i + 1) * 8))
+    assert history_total == expected_rank0
