@@ -45,7 +45,7 @@ class _Owned:
 
     __slots__ = ("event", "inline", "value_cached", "has_cached", "location",
                  "store_name", "error", "spec", "retries_left", "borrowers",
-                 "cancelled")
+                 "cancelled", "size", "spilled_path")
 
     def __init__(self, spec: TaskSpec | None = None, retries_left: int = 0):
         self.event = threading.Event()
@@ -57,6 +57,8 @@ class _Owned:
         self.error: BaseException | None = None
         self.spec = spec
         self.retries_left = retries_left
+        self.size = 0  # serialized bytes (locality scoring)
+        self.spilled_path: str | None = None  # disk tier (spilled primary)
         # rpc addresses of processes borrowing this object's store bytes;
         # the owner keeps the value alive until every borrower releases
         # (reference: borrower bookkeeping, core_worker/reference_count.h:66)
@@ -68,6 +70,38 @@ class _Context(threading.local):
     def __init__(self):
         self.actor_id = None
         self.task_id = None
+
+
+class _HeldLease:
+    """Submitter-side record of a leased worker (reference: lease reuse,
+    core_worker/transport/normal_task_submitter.cc:137)."""
+
+    __slots__ = ("lease_id", "worker_id", "address", "inflight",
+                 "last_active", "broken", "key", "nodelet")
+
+    def __init__(self, lease_id, worker_id, address, key, nodelet):
+        self.lease_id = lease_id
+        self.worker_id = worker_id
+        self.address = address
+        self.inflight: set[bytes] = set()  # task_ids pushed, not yet done
+        self.last_active = time.monotonic()
+        self.broken = False
+        self.key = key
+        self.nodelet = nodelet  # which nodelet granted (return/renew here)
+
+
+# max in-flight pushes per leased worker: one executing + one buffered
+# at the worker keeps the wire full without committing a backlog to a
+# single worker (excess waits CLIENT-side where it can still move to
+# newly granted leases on other nodes)
+_LEASE_PIPELINE_DEPTH = 2
+_LEASE_IDLE_RETURN_S = 2.0
+
+
+def _ack_timeout() -> float:
+    from ray_tpu.core import config as cfg
+
+    return cfg.get("ACK_TIMEOUT_S")
 
 
 class ClusterRuntime:
@@ -86,6 +120,9 @@ class ClusterRuntime:
         self._refcounts: dict[bytes, int] = {}
         self._fn_cache: dict[str, Callable] = {}
         self._exported_fns: set[str] = set()
+        import weakref
+
+        self._fn_id_cache = weakref.WeakKeyDictionary()  # fn -> fn_id
         self._actor_addr: dict[bytes, str] = {}
         self._actor_meta: dict[bytes, dict] = {}
         # in-flight actor calls by actor: when an actor dies/restarts, its
@@ -107,8 +144,23 @@ class ClusterRuntime:
         self._task_arg_refs: dict[bytes, list[bytes]] = {}
         self._booted = []  # in-process services we own (head/nodelet)
         self._shutdown_flag = False
+        # worker-lease reuse + pipelined submission state
+        self._lease_pools: dict[tuple, list] = {}  # key -> [_HeldLease]
+        self._lease_pending: dict[tuple, list] = {}  # key -> [TaskSpec]
+        self._task_lease: dict[bytes, tuple] = {}  # task_id -> (lease, spec)
+        # in-flight submission acks: [deadline, future, resend_fn, fail_fn]
+        self._pending_acks: list = []
+        # per-key lease cap: bounds CLUSTER-wide workers one submitter can
+        # hold, not this process's cores — nodelet denials (with 50ms
+        # negative caching) are the real admission control
+        self._lease_cap = 64
+        self._lease_backoff: dict[tuple, float] = {}  # key -> retry-after
+        self._last_renew = 0.0
+        self._last_backlog = 0
 
         self.server = RpcServer(name=f"rt-{mode}", num_threads=32)
+        self.server.register("lease_broken", self._h_lease_broken,
+                             oneway=True)
         self.server.register("task_done", self._h_task_done, oneway=True)
         self.server.register("resolve", self._h_resolve)
         self.server.register("borrow_release", self._h_borrow_release,
@@ -128,6 +180,8 @@ class ClusterRuntime:
             self.node_id = None
             self.store = None
         self.server.start()
+        threading.Thread(target=self._submit_sweeper, daemon=True,
+                         name=f"rt-{mode}-sweep").start()
         # actor lifecycle events keep the address cache + arg pins fresh
         try:
             self.client.call(self.head_address, "subscribe",
@@ -216,6 +270,13 @@ class ClusterRuntime:
                 pass
 
     def _free_remote_bytes(self, st: "_Owned", b: bytes):
+        if st.spilled_path is not None:
+            try:
+                os.unlink(st.spilled_path)
+            except OSError:
+                pass
+            st.spilled_path = None
+            return
         with self._lock:
             if st.location is not None and self.nodelet_address:
                 try:
@@ -234,19 +295,30 @@ class ClusterRuntime:
         b = oid.binary()
         st = _Owned()
         head_payload, views, total = ser.serialize(value)
+        st.size = total
         if total <= INLINE_THRESHOLD or self.store is None:
             buf = bytearray(total)
             ser.write_into(memoryview(buf), head_payload, views)
             st.inline = bytes(buf)
         else:
-            try:
-                buf = self.store.create(b, total)
-                ser.write_into(buf, head_payload, views)
-                del buf
-                self.store.seal(b)
-                st.location = "local"
-                st.store_name = self.store.name
-            except Exception:
+            wrote = False
+            for attempt in range(2):
+                try:
+                    buf = self.store.create(b, total)
+                    ser.write_into(buf, head_payload, views)
+                    del buf
+                    self.store.seal(b)
+                    st.location = "local"
+                    st.store_name = self.store.name
+                    wrote = True
+                    break
+                except Exception:  # noqa: BLE001
+                    # store full: spill our own primary copies to the disk
+                    # tier and retry once (reference: LocalObjectManager
+                    # spilling, raylet/local_object_manager.h:41)
+                    if attempt == 0 and not self._spill_primaries(total):
+                        break
+            if not wrote:
                 buf = bytearray(total)
                 ser.write_into(memoryview(buf), head_payload, views)
                 st.inline = bytes(buf)
@@ -256,6 +328,84 @@ class ClusterRuntime:
         with self._lock:
             self._owned[b] = st
         return ObjectRef(oid, owner=self.address)
+
+    # ------------------------------------------------------------ spilling
+    # Owner-driven disk tier (reference: raylet LocalObjectManager,
+    # local_object_manager.h:41 — spill pinned primaries under memory
+    # pressure, restore on access; the owner tracks the spilled URL).
+    # Ownership centralizes the metadata, so the owner is the natural
+    # spill coordinator for its own primaries.
+
+    _SPILL_MIN_BYTES = 64 * 1024
+
+    def _spill_dir(self) -> str:
+        base = getattr(self, "session_dir", None) or \
+            os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu")
+        d = os.path.join(base, "spill", f"pid{os.getpid()}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _spill_primaries(self, nbytes_needed: int) -> int:
+        """Spill oldest eligible local primaries until ~nbytes_needed of
+        store space has been reclaimed. Returns bytes reclaimed."""
+        if self.store is None:
+            return 0
+        candidates = []
+        with self._lock:
+            for b, st in self._owned.items():
+                if (st.event.is_set() and st.location == "local"
+                        and st.spilled_path is None and not st.borrowers
+                        and st.error is None
+                        and st.size >= self._SPILL_MIN_BYTES
+                        and b not in self._pins):
+                    candidates.append((b, st))
+        freed = 0
+        spill_dir = None
+        for b, st in candidates:  # dict order == insertion order (oldest first)
+            if freed >= nbytes_needed:
+                break
+            view = self.store.get(b)
+            if view is None:
+                continue
+            try:
+                if spill_dir is None:
+                    spill_dir = self._spill_dir()
+                path = os.path.join(spill_dir, b.hex())
+                with open(path, "wb") as f:
+                    f.write(view)
+            except OSError:
+                del view
+                self.store.release(b)
+                return freed
+            size = view.nbytes
+            del view
+            self.store.release(b)   # our read hold
+            with self._lock:
+                # COMMIT point: _h_resolve registers borrowers under this
+                # same lock — re-check so we never delete shm bytes a
+                # just-registered borrower was promised (spill/borrow race)
+                if st.borrowers or b in self._pins or \
+                        st.spilled_path is not None:
+                    committed = False
+                else:
+                    committed = True
+                    st.spilled_path = path
+                    st.location = "spilled"
+                    st.store_name = None
+                    # drop the value cache: the point of spilling is
+                    # releasing memory
+                    st.value_cached = None
+                    st.has_cached = False
+            if not committed:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            self.store.release(b)   # the primary (creator) pin
+            self.store.delete(b)
+            freed += size
+        return freed
 
     def get(self, refs: list[ObjectRef], timeout=None):
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -282,6 +432,18 @@ class ClusterRuntime:
                     raise st.error
                 if st.has_cached:
                     return st.value_cached
+                if st.spilled_path is not None:
+                    # disk tier: read back without evicting anything else
+                    try:
+                        with open(st.spilled_path, "rb") as f:
+                            data = f.read()
+                    except OSError as e:
+                        raise exc.ObjectLostError(
+                            f"spilled object {ref} lost: {e}") from e
+                    value = ser.deserialize(memoryview(data))
+                    st.value_cached = value
+                    st.has_cached = True
+                    return value
                 try:
                     value = self._materialize(b, st.inline, st.location,
                                               st.store_name)
@@ -499,13 +661,34 @@ class ClusterRuntime:
             return {"status": "error"}, [ser.dumps_msg(st.error)]
         if st.inline is not None:
             return {"status": "inline"}, [st.inline]
+        if st.spilled_path is not None:
+            # disk tier: serve the bytes directly from the spill file
+            # (reference: spilled objects are restored/served via their
+            # spilled URL, local_object_manager.h:41). Serving inline
+            # avoids a restore storm re-pressuring the store that forced
+            # the spill in the first place; no borrow registration needed
+            # since the reply carries the full payload.
+            try:
+                with open(st.spilled_path, "rb") as f:
+                    return {"status": "inline"}, [f.read()]
+            except OSError:
+                # racing un-spill/free: fall through to the live state
+                pass
         borrower = msg.get("borrower")
         if borrower:
             # register atomically with the location handout: the bytes
-            # stay pinned until this borrower sends borrow_release
+            # stay pinned until this borrower sends borrow_release. The
+            # spiller commits under this same lock and skips objects with
+            # borrowers, so this cannot race a concurrent spill.
             with self._lock:
                 if self._owned.get(msg["oid"]) is not st:
                     return {"status": "unknown"}  # freed while we waited
+                if st.spilled_path is not None:
+                    try:
+                        with open(st.spilled_path, "rb") as f:
+                            return {"status": "inline"}, [f.read()]
+                    except OSError:
+                        return {"status": "unknown"}
                 st.borrowers.add(borrower)
         if st.location == "local":
             # owner-local store: hand out bytes directly (borrower may be
@@ -542,6 +725,14 @@ class ClusterRuntime:
                     pend = self._inflight_actor.get(ab)
                     if pend is not None:
                         pend.pop(task_id, None)
+                ent = self._task_lease.pop(task_id, None)
+                if ent is not None:
+                    ent[0].inflight.discard(task_id)
+                    ent[0].last_active = time.monotonic()
+        else:
+            ent = None
+        if ent is not None:
+            self._refill_lease(ent[0])
         err_blob = msg.get("error")
         if err_blob is not None:
             try:
@@ -564,9 +755,11 @@ class ClusterRuntime:
             loc = locations[i] if i < len(locations) else None
             if loc is None:
                 st.inline = frames[i] if i < len(frames) else None
+                st.size = len(st.inline or b"")
             else:
                 st.location = loc["address"]
                 st.store_name = loc.get("store_name")
+                st.size = loc.get("size", 0)
             st.event.set()
 
     def _task_failed(self, oids, error, retryable) -> bool:
@@ -641,6 +834,14 @@ class ClusterRuntime:
     # ------------------------------------------------------------ tasks
 
     def _export_fn(self, fn) -> str:
+        # identity-level cache: repeated submits of the same function
+        # object must not re-pickle it every call (hot-path cost)
+        try:
+            fn_id = self._fn_id_cache.get(fn)
+        except TypeError:  # non-weakrefable callable (e.g. np.ufunc)
+            fn_id = None
+        if fn_id is not None:
+            return fn_id
         blob = cloudpickle.dumps(fn)
         fn_id = hashlib.sha1(blob).hexdigest()
         if fn_id not in self._exported_fns:
@@ -649,6 +850,10 @@ class ClusterRuntime:
                              frames=[blob], timeout=30, retries=2)
             self._exported_fns.add(fn_id)
             self._fn_cache[fn_id] = fn
+        try:
+            self._fn_id_cache[fn] = fn_id
+        except TypeError:
+            pass  # unhashable callable
         return fn_id
 
     def _fetch_fn(self, fn_id: str) -> Callable:
@@ -742,16 +947,330 @@ class ClusterRuntime:
                 self._owned[o.binary()] = _Owned(spec=spec,
                                                 retries_left=opts.max_retries)
         self._pin_task_args(spec.task_id, ref_oids)
-        target = self.nodelet_address
-        if pg_id is not None:
-            target = self._pg_node_address(pg_id, opts.placement_group_bundle_index,
-                                           spec.resources) or target
-        self.client.call(target, "schedule_task", {"spec": dataclass_dict(spec)},
-                         timeout=60, retries=2)
+        # arg locality: prefer the node already holding the largest args
+        # (reference: LocalityAwareLeasePolicy, core_worker/lease_policy.h:58)
+        locality = (None if pg_id is not None
+                    else self._locality_target(ref_oids))
+        # hot path: repeated same-shape tasks ride a reused worker lease
+        # (direct pipelined push — no per-task scheduling hop; reference:
+        # normal_task_submitter.cc:137 OnWorkerIdle)
+        leased = (pg_id is None and not opts.label_selector
+                  and locality is None
+                  and self.nodelet_address is not None
+                  and self._submit_via_lease(spec))
+        if not leased:
+            target = locality or self.nodelet_address
+            if pg_id is not None:
+                target = self._pg_node_address(
+                    pg_id, opts.placement_group_bundle_index,
+                    spec.resources) or target
+            if target != self.nodelet_address:
+                self._prefetch_args(target, spec)
+            self.client.call(target, "schedule_task",
+                             {"spec": dataclass_dict(spec)},
+                             timeout=60, retries=2)
         refs = [ObjectRef(o, owner=self.address) for o in oids]
         if n == 0:
             return []
         return refs[0] if n == 1 else refs
+
+    # locality only kicks in above this many serialized arg bytes — tiny
+    # args are cheaper to move than a cross-node scheduling decision
+    _LOCALITY_MIN_BYTES = 256 * 1024
+
+    def _locality_target(self, ref_oids: list[bytes]) -> str | None:
+        """Nodelet address holding the largest share of this task's
+        store-resident args, if it is not the local nodelet (reference:
+        lease_policy.h:58 best-locality node from the ownership table)."""
+        if not ref_oids:
+            return None
+        by_addr: dict[str, int] = {}
+        with self._lock:
+            for b in ref_oids:
+                st = self._owned.get(b)
+                if st is None or not st.event.is_set() or \
+                        st.location is None or st.size <= 0 or \
+                        st.spilled_path is not None:
+                    continue
+                addr = (self.nodelet_address if st.location == "local"
+                        else st.location)
+                if addr:
+                    by_addr[addr] = by_addr.get(addr, 0) + st.size
+        if not by_addr:
+            return None
+        best = max(by_addr, key=by_addr.get)
+        if best == self.nodelet_address or \
+                by_addr[best] < self._LOCALITY_MIN_BYTES:
+            return None
+        return best
+
+    # ------------------------------------------------------------ leases
+
+    def _lease_key(self, spec: TaskSpec) -> tuple:
+        from ray_tpu.core import runtime_env as rtenv
+
+        return (json_stable(spec.resources), rtenv.env_hash(spec.runtime_env))
+
+    def _submit_via_lease(self, spec: TaskSpec) -> bool:
+        """Route the task through the lease layer (reference model: the
+        core_worker queues tasks client-side and pushes one per granted
+        lease, normal_task_submitter.cc:137).
+
+        Selection order (parallelism first, then pipelining):
+        1. an idle held lease (inflight == 0);
+        2. a NEW lease while some nodelet grants one (spillback-following,
+           with a short negative-cache backoff on denial);
+        3. pipeline onto a lease below the depth cap;
+        4. otherwise queue CLIENT-side — drained on task_done refills and
+           by the sweeper's lease re-requests, so backlog can still move
+           to new capacity (autoscaled nodes) instead of being committed
+           to one worker's inbox.
+        """
+        key = self._lease_key(spec)
+        now = time.monotonic()
+        with self._lock:
+            pool = self._lease_pools.setdefault(key, [])
+            pool[:] = [le for le in pool if not le.broken]
+            pending = self._lease_pending.setdefault(key, [])
+            lease = next((le for le in pool if not le.inflight), None)
+            need_new = (lease is None and len(pool) < self._lease_cap
+                        and now > self._lease_backoff.get(key, 0.0))
+        if need_new:
+            lease = self._request_lease(key, spec)
+            if lease is None:
+                with self._lock:
+                    self._lease_backoff[key] = now + 0.05
+        with self._lock:
+            if lease is None or lease.broken:
+                lease = min(
+                    (le for le in pool
+                     if not le.broken
+                     and len(le.inflight) < _LEASE_PIPELINE_DEPTH),
+                    key=lambda le: len(le.inflight), default=None)
+            if lease is None:
+                pending.append(spec)
+                return True
+            lease.inflight.add(spec.task_id)
+            lease.last_active = time.monotonic()
+            self._task_lease[spec.task_id] = (lease, spec)
+        self._push_leased(lease, spec)
+        return True
+
+    def _refill_lease(self, lease: _HeldLease):
+        """A slot freed on this lease: push the next client-queued task
+        (the OnWorkerIdle moment — keeps the pipe full without a sweeper
+        round trip)."""
+        with self._lock:
+            if lease.broken or \
+                    len(lease.inflight) >= _LEASE_PIPELINE_DEPTH:
+                return
+            pending = self._lease_pending.get(lease.key)
+            if not pending:
+                return
+            spec = pending.pop(0)
+            lease.inflight.add(spec.task_id)
+            lease.last_active = time.monotonic()
+            self._task_lease[spec.task_id] = (lease, spec)
+        self._push_leased(lease, spec)
+
+    def _request_lease(self, key: tuple, spec: TaskSpec):
+        """Ask the local nodelet for a worker lease, following spillback
+        redirects to other nodes (reference: RequestWorkerLease spillback
+        in the raylet; up to MAX_SPILLBACKS-style hop bound)."""
+        target = self.nodelet_address
+        for _hop in range(4):
+            try:
+                r = self.client.call(target, "request_lease", {
+                    "resources": spec.resources,
+                    "runtime_env": spec.runtime_env,
+                    "owner": self.address,
+                }, timeout=70)
+            except Exception:  # noqa: BLE001
+                return None
+            if r.get("granted"):
+                lease = _HeldLease(r["lease_id"], r["worker_id"],
+                                   r["address"], key, target)
+                with self._lock:
+                    self._lease_pools.setdefault(key, []).append(lease)
+                return lease
+            spill = r.get("spill")
+            if not spill or spill == target:
+                return None
+            target = spill
+        return None
+
+    # push transfer kicks in above this arg size (tiny args ride the pull)
+    _PUSH_MIN_BYTES = 256 * 1024
+
+    def _prefetch_args(self, exec_nodelet: str, spec: TaskSpec):
+        """Owner-directed push of large args toward the execution node
+        (reference: push_manager.h:30) — fire-and-forget; overlaps the
+        transfer with scheduling/queueing latency."""
+        if not exec_nodelet:
+            return
+        for a in list(spec.args) + list(spec.kwargs.values()):
+            if not isinstance(a, RefArg):
+                continue
+            with self._lock:
+                st = self._owned.get(a.oid)
+            if st is None or not st.event.is_set() or \
+                    st.size < self._PUSH_MIN_BYTES or \
+                    st.spilled_path is not None or st.location is None:
+                continue
+            src = (self.nodelet_address if st.location == "local"
+                   else st.location)
+            if not src or src == exec_nodelet:
+                continue
+            try:
+                self.client.send_oneway(exec_nodelet, "prefetch_object",
+                                        {"oid": a.oid, "location": src})
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _push_leased(self, lease: _HeldLease, spec: TaskSpec,
+                     acks_left: int = 2):
+        if acks_left == 2 and lease.nodelet != self.nodelet_address:
+            self._prefetch_args(lease.nodelet, spec)
+        fut = self.client.call_async(lease.address, "execute_leased",
+                                     {"spec": dataclass_dict(spec),
+                                      "attempt": spec.attempt})
+
+        def resend():
+            self._push_leased(lease, spec, acks_left - 1)
+
+        def fail():
+            # enqueue-ack never arrived: worker presumed gone; the task
+            # becomes a retryable failure (dedup at the worker makes a
+            # slow-but-delivered original harmless)
+            self._lease_task_failed(lease, spec)
+
+        with self._lock:
+            self._pending_acks.append(
+                [time.monotonic() + _ack_timeout(), fut, resend,
+                 fail if acks_left <= 0 else None])
+
+    def _lease_task_failed(self, lease: _HeldLease, spec: TaskSpec):
+        with self._lock:
+            ent = self._task_lease.pop(spec.task_id, None)
+            if ent is None:
+                return  # completed meanwhile
+            lease.inflight.discard(spec.task_id)
+        self._task_failed(
+            spec.return_oids,
+            exc.WorkerCrashedError(
+                f"leased worker for {spec.name} became unreachable"),
+            retryable=True)
+
+    def _h_lease_broken(self, msg, frames):
+        """Nodelet reports a leased worker died: resubmit our in-flight
+        pushes (retryable — honors each task's retry budget)."""
+        lease_id = msg["lease_id"]
+        with self._lock:
+            victims = []
+            for pool in self._lease_pools.values():
+                for le in pool:
+                    if le.lease_id == lease_id:
+                        le.broken = True
+                        victims = [self._task_lease[tid]
+                                   for tid in list(le.inflight)
+                                   if tid in self._task_lease]
+                pool[:] = [le for le in pool if not le.broken]
+        for lease, spec in victims:
+            self._lease_task_failed(lease, spec)
+
+    def _submit_sweeper(self):
+        """Background loop: submission-ack timeouts/retries, lease renewal,
+        and idle-lease return."""
+        while not self._shutdown_flag:
+            time.sleep(0.25)
+            now = time.monotonic()
+            resend, fail = [], []
+            with self._lock:
+                remaining = []
+                for ent in self._pending_acks:
+                    deadline, fut, resend_fn, fail_fn = ent
+                    if fut.done() and fut.exception() is None:
+                        continue  # acked
+                    if fut.done() or now > deadline:
+                        # failed or timed out: resend while retries remain
+                        # (fail_fn is set only once retries are exhausted)
+                        (fail if fail_fn is not None or resend_fn is None
+                         else resend).append(ent)
+                    else:
+                        remaining.append(ent)
+                self._pending_acks = remaining
+            for _, _, resend_fn, _ in resend:
+                try:
+                    resend_fn()
+                except Exception:  # noqa: BLE001
+                    pass
+            for _, _, _, fail_fn in fail:
+                if fail_fn is not None:
+                    try:
+                        fail_fn()
+                    except Exception:  # noqa: BLE001
+                        pass
+            self._sweep_leases(now)
+
+    def _sweep_leases(self, now: float):
+        to_return = []
+        renew_by_nodelet: dict[str, list[bytes]] = {}
+        backlog = 0
+        grow = []  # (key, example spec) with client-queued backlog
+        with self._lock:
+            for key, pool in self._lease_pools.items():
+                keep = []
+                for le in pool:
+                    if not le.inflight and \
+                            now - le.last_active > _LEASE_IDLE_RETURN_S:
+                        to_return.append(le)
+                    else:
+                        keep.append(le)
+                        renew_by_nodelet.setdefault(
+                            le.nodelet, []).append(le.lease_id)
+                        # tasks buffered BEHIND the executing one are
+                        # unmet demand the cluster can't see — count them
+                        # toward the autoscaler's backlog signal
+                        backlog += max(0, len(le.inflight) - 1)
+                pool[:] = keep
+            for key, pending in self._lease_pending.items():
+                backlog += len(pending)
+                if pending and \
+                        len(self._lease_pools.get(key, ())) < self._lease_cap \
+                        and now > self._lease_backoff.get(key, 0.0):
+                    grow.append((key, pending[0]))
+        # client-queued backlog: try to grow capacity (new nodes may have
+        # appeared — autoscaler scale-up, lease returns elsewhere)
+        for key, spec in grow:
+            lease = self._request_lease(key, spec)
+            if lease is None:
+                with self._lock:
+                    self._lease_backoff[key] = now + 0.5
+            else:
+                for _ in range(_LEASE_PIPELINE_DEPTH):
+                    self._refill_lease(lease)
+        if self.nodelet_address and (backlog or self._last_backlog):
+            self._last_backlog = backlog
+            try:
+                self.client.send_oneway(self.nodelet_address, "lease_demand",
+                                        {"owner": self.address,
+                                         "count": backlog})
+            except Exception:  # noqa: BLE001
+                pass
+        for le in to_return:
+            try:
+                self.client.send_oneway(le.nodelet, "return_lease",
+                                        {"lease_id": le.lease_id})
+            except Exception:  # noqa: BLE001
+                pass
+        if renew_by_nodelet and now - self._last_renew > 10.0:
+            self._last_renew = now
+            for nodelet, ids in renew_by_nodelet.items():
+                try:
+                    self.client.send_oneway(nodelet, "renew_leases",
+                                            {"lease_ids": ids})
+                except Exception:  # noqa: BLE001
+                    pass
 
     def _pg_node_address(self, pg_id: bytes, bundle_index: int, resources):
         try:
@@ -865,6 +1384,14 @@ class ClusterRuntime:
         # killed the actor, kill every restart and burn the whole restart
         # budget. Opt-in retries re-resolve the (possibly restarted) actor.
         tries = 1 + int(mopts.get("max_task_retries", 0) or 0)
+        if tries == 1:
+            # hot path: PIPELINED push — don't block on the enqueue-ack
+            # (the result arrives via task_done; the ack only guards
+            # delivery). The submit sweeper errors the oids if the ack
+            # never lands; actor-death pubsub covers a dead peer.
+            self._submit_actor_pipelined(ab, task_id, msg, oids)
+            refs = [ObjectRef(o, owner=self.address) for o in oids]
+            return refs[0] if n == 1 else refs
         last_err = None
         for attempt in range(tries):
             try:
@@ -901,6 +1428,45 @@ class ClusterRuntime:
             self._unpin_task_args(task_id)
         refs = [ObjectRef(o, owner=self.address) for o in oids]
         return refs[0] if n == 1 else refs
+
+    def _submit_actor_pipelined(self, ab: bytes, task_id: bytes, msg: dict,
+                                oids):
+        # flow control: bound unacked pushes (worker-side dedup window is
+        # 20k; runaway submit loops must not queue unbounded memory)
+        while True:
+            with self._lock:
+                if len(self._pending_acks) < 10000:
+                    break
+            time.sleep(0.001)
+        obids = [o.binary() for o in oids]
+        try:
+            addr = self._resolve_actor(ab)
+        except exc.RayTpuError as e:
+            self._error_oids(obids, e)
+            self._unpin_task_args(task_id)
+            return
+        # register BEFORE the push: a fast task_done must find the entry
+        with self._lock:
+            self._inflight_actor.setdefault(ab, {})[task_id] = obids
+            self._task_actor[task_id] = ab
+        fut = self.client.call_async(addr, "actor_call", msg)
+
+        def fail():
+            with self._lock:
+                done = task_id not in self._task_actor
+                pend = self._inflight_actor.get(ab)
+                if pend is not None:
+                    pend.pop(task_id, None)
+                self._task_actor.pop(task_id, None)
+                self._actor_addr.pop(ab, None)  # force re-resolve next call
+            if not done:
+                self._error_oids(obids, exc.ActorUnavailableError(
+                    "actor call delivery failed (no enqueue ack)"))
+                self._unpin_task_args(task_id)
+
+        with self._lock:
+            self._pending_acks.append(
+                [time.monotonic() + _ack_timeout(), fut, None, fail])
 
     def _error_oids(self, oids, error):
         for b in oids:
@@ -988,6 +1554,17 @@ class ClusterRuntime:
             return
         self._shutdown_flag = True
         atexit.unregister(self.shutdown)
+        # hand leased workers back (the nodelet's TTL would reclaim them,
+        # but a clean return keeps the pool warm for the next driver)
+        with self._lock:
+            held = [le for pool in self._lease_pools.values() for le in pool]
+            self._lease_pools.clear()
+        for le in held:
+            try:
+                self.client.send_oneway(le.nodelet, "return_lease",
+                                        {"lease_id": le.lease_id})
+            except Exception:  # noqa: BLE001
+                pass
         self.server.stop()
         for oid in list(self._pins):
             self._release_pin(oid)

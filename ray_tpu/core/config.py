@@ -39,6 +39,7 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
                                "values at/below ride inline in RPCs"),
     # --- tasks
     "TASK_MAX_RETRIES": (int, 3, "default task retry budget"),
+    "ACK_TIMEOUT_S": (float, 30.0, "submission enqueue-ack deadline"),
 }
 
 _lock = threading.Lock()

@@ -99,6 +99,7 @@ class Head:
         s.register("remove_pg", self._h_remove_pg)
         s.register("list_actors", self._h_list_actors)
         s.register("task_event", self._h_task_event, oneway=True)
+        s.register("task_events", self._h_task_events, oneway=True)
         s.register("list_tasks", self._h_list_tasks)
         s.register("ping", lambda m, f: "pong")
         self._monitor = threading.Thread(target=self._monitor_loop, daemon=True,
@@ -472,6 +473,12 @@ class Head:
         bounded in-memory store feeding the state API)."""
         with self._lock:
             self._task_events.append(msg)
+
+    def _h_task_events(self, msg, frames):
+        """Batched variant (workers buffer events; reference:
+        task_event_buffer.h periodic flush)."""
+        with self._lock:
+            self._task_events.extend(msg.get("events", ()))
 
     def _h_list_tasks(self, msg, frames):
         limit = int(msg.get("limit", 1000))

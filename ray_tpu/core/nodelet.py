@@ -38,7 +38,7 @@ from ray_tpu.core.specs import ActorSpec, TaskSpec
 class _Worker:
     __slots__ = ("worker_id", "proc", "address", "idle", "current_task",
                  "actor_id", "ready", "acquired", "tpu", "bundle",
-                 "env_hash")
+                 "env_hash", "lease_id")
 
     def __init__(self, worker_id: bytes, proc, tpu: bool = False,
                  env_hash: str = ""):
@@ -56,6 +56,24 @@ class _Worker:
         self.bundle = None  # ((pg_id, idx), resources) for PG-metered work
         self.tpu = tpu  # spawned with TPU device visibility
         self.env_hash = env_hash  # runtime-env identity for reuse matching
+        self.lease_id = None  # held by a submitter for direct task pushes
+
+
+class _Lease:
+    """A worker granted to one submitter for repeated direct pushes
+    (reference: worker lease reuse, normal_task_submitter.cc:137)."""
+
+    __slots__ = ("lease_id", "worker", "owner", "resources", "expiry")
+
+    def __init__(self, lease_id, worker, owner, resources, expiry):
+        self.lease_id = lease_id
+        self.worker = worker
+        self.owner = owner
+        self.resources = resources
+        self.expiry = expiry
+
+
+LEASE_TTL_S = 30.0
 
 
 class Nodelet:
@@ -70,6 +88,16 @@ class Nodelet:
         self.head_address = head_address
         self.resources = dict(resources)
         self.labels = dict(labels or {})
+        # slice identity: merge env-detected labels (real TPU VMs) under
+        # any asserted ones, and assert the slice-head marker resource on
+        # worker 0 (reference: accelerators/tpu.py TPU-{pod}-head)
+        from ray_tpu.core import tpu as tpu_mod
+
+        if self.resources.get("TPU", 0) > 0:
+            for k, v in tpu_mod.detect_slice_labels().items():
+                self.labels.setdefault(k, v)
+            for r, q in tpu_mod.head_marker_resources(self.labels).items():
+                self.resources.setdefault(r, q)
         self.session_dir = session_dir
         self.log_dir = os.path.join(session_dir, "logs")
         os.makedirs(self.log_dir, exist_ok=True)
@@ -92,6 +120,14 @@ class Nodelet:
         self._idle_workers: deque[_Worker] = deque()
         self._bundles: dict[tuple, dict] = {}  # (pg_id, idx) -> reserved
         self._bundle_free: dict[tuple, dict] = {}  # (pg_id, idx) -> remaining
+        self._leases: dict[bytes, _Lease] = {}  # lease_id -> lease
+        # bounded concurrent inbound object pulls (pull admission control)
+        self._pull_sem = threading.BoundedSemaphore(4)
+        self._pull_waiters = 0
+        # submitter-reported pipelined backlog: owner -> (expiry, count).
+        # Feeds the heartbeat queue_len so the autoscaler sees demand that
+        # never materializes as nodelet-queued tasks.
+        self._lease_demand: dict[str, tuple[float, int]] = {}
         self._cluster_view = []
         self._view_ts = 0.0
         self._pull_chunks_served = 0  # chunked-transfer observability
@@ -123,8 +159,13 @@ class Nodelet:
         s.register("pull_chunk", self._h_pull_chunk)
         s.register("pull_object", self._h_pull_object)
         s.register("free_object", self._h_free_object, oneway=True)
+        s.register("prefetch_object", self._h_prefetch_object, oneway=True)
         s.register("reserve_bundle", self._h_reserve_bundle)
         s.register("release_bundle", self._h_release_bundle)
+        s.register("request_lease", self._h_request_lease)
+        s.register("return_lease", self._h_return_lease)
+        s.register("renew_leases", self._h_renew_leases, oneway=True)
+        s.register("lease_demand", self._h_lease_demand, oneway=True)
         s.register("node_info", self._h_node_info)
         s.register("ping", lambda m, f: "pong")
 
@@ -181,11 +222,25 @@ class Nodelet:
         self.store.close()
         self.store.unlink()
 
+    def _h_lease_demand(self, msg, frames):
+        owner = msg.get("owner")
+        count = int(msg.get("count", 0))
+        with self._lock:
+            if count <= 0:
+                self._lease_demand.pop(owner, None)
+            else:
+                self._lease_demand[owner] = (time.monotonic() + 2.0, count)
+
     def _heartbeat_loop(self):
         while not self._stopped.wait(HEARTBEAT_INTERVAL_S):
+            now = time.monotonic()
             with self._lock:
                 avail = dict(self._available)
-                qlen = len(self._queue)
+                for o in [o for o, (exp, _) in self._lease_demand.items()
+                          if exp < now]:
+                    self._lease_demand.pop(o, None)
+                qlen = len(self._queue) + sum(
+                    c for _, c in self._lease_demand.values())
             try:
                 self.client.send_oneway(self.head_address, "heartbeat",
                                         {"node_id": self.node_id,
@@ -197,7 +252,8 @@ class Nodelet:
     # ------------------------------------------------------------ workers
 
     def _spawn_worker(self, tpu: bool = False,
-                      runtime_env: dict | None = None) -> _Worker:
+                      runtime_env: dict | None = None,
+                      lease_id: bytes | None = None) -> _Worker:
         from ray_tpu.core import runtime_env as rtenv
         from ray_tpu.core.ids import WorkerID
 
@@ -249,6 +305,10 @@ class Nodelet:
             start_new_session=True, cwd=cwd,
         )
         w = _Worker(wid, proc, tpu=tpu, env_hash=ehash)
+        # leased-at-birth: set BEFORE registration so a worker_ready racing
+        # this return can't park the worker in the idle pool where another
+        # lease request would double-grant it
+        w.lease_id = lease_id
         with self._lock:
             self._workers[wid] = w
         return w
@@ -260,11 +320,125 @@ class Nodelet:
                 return {}
             w.address = msg["address"]
             w.ready.set()
-            if w.actor_id is None and not w.idle and w.current_task is None:
+            if w.actor_id is None and not w.idle and \
+                    w.current_task is None and w.lease_id is None:
                 w.idle = True
                 self._idle_workers.append(w)
         self._dispatch_wake.set()
         return {}
+
+    # ------------------------------------------------------------ leases
+    # Worker-lease reuse (reference: NormalTaskSubmitter::OnWorkerIdle
+    # lease caching, core_worker/transport/normal_task_submitter.cc:137):
+    # a submitter leases a worker once, then pushes repeated same-shape
+    # tasks DIRECTLY to it — no per-task scheduling hop. The lease holds
+    # the task's resources until returned, TTL-expired (owner died), or
+    # the worker dies (owner gets lease_broken and resubmits).
+
+    def _h_request_lease(self, msg, frames):
+        from ray_tpu.core import runtime_env as _rtenv
+
+        resources = dict(msg.get("resources") or {})
+        runtime_env = msg.get("runtime_env")
+        needs_tpu = resources.get("TPU", 0) > 0
+        want_env = _rtenv.env_hash(runtime_env)
+        lease_id = os.urandom(8)
+        with self._lock:
+            can_run = self._can_run(resources)
+        if not can_run:
+            # lease spillback: point the submitter at the best other node
+            # (reference: raylet replies with a spillback node in
+            # RequestWorkerLease, local_task_manager spillback). View RPC
+            # happens OFF the nodelet lock.
+            best = self._best_fit_node(resources,
+                                       self._cluster_view_cached(),
+                                       exclude_node_id=self.node_id)
+            if best is not None:
+                return {"granted": False, "reason": "no-capacity",
+                        "spill": best["address"]}
+            return {"granted": False, "reason": "no-capacity"}
+        with self._lock:
+            if not self._can_run(resources):
+                return {"granted": False, "reason": "no-capacity"}
+            w = None
+            for cand in list(self._idle_workers):
+                if cand.worker_id in self._workers and \
+                        cand.tpu == needs_tpu and cand.env_hash == want_env:
+                    w = cand
+                    self._idle_workers.remove(cand)
+                    break
+            if w is None:
+                n_task_workers = sum(1 for x in self._workers.values()
+                                     if x.actor_id is None)
+                if n_task_workers >= self._max_task_workers:
+                    return {"granted": False, "reason": "worker-cap"}
+            # acquire before the (slow) spawn so racing submitters spill
+            for r, q in resources.items():
+                self._available[r] -= q
+            if w is not None:
+                w.idle = False
+                w.lease_id = lease_id  # claim inside THIS lock hold
+        def _rollback():
+            with self._lock:
+                for r, q in resources.items():
+                    self._available[r] = min(self.resources.get(r, 0.0),
+                                             self._available[r] + q)
+        if w is None:
+            try:
+                w = self._spawn_worker(tpu=needs_tpu, runtime_env=runtime_env,
+                                       lease_id=lease_id)
+            except Exception as e:  # noqa: BLE001
+                _rollback()
+                return {"granted": False, "reason": f"spawn failed: {e}"}
+        if not w.ready.wait(timeout=60):
+            with self._lock:
+                w.lease_id = None
+            _rollback()
+            return {"granted": False, "reason": "worker-start-timeout"}
+        with self._lock:
+            w.acquired = dict(resources)
+            self._leases[lease_id] = _Lease(
+                lease_id, w, msg.get("owner"), resources,
+                time.monotonic() + LEASE_TTL_S)
+        return {"granted": True, "lease_id": lease_id,
+                "worker_id": w.worker_id, "address": w.address}
+
+    def _h_return_lease(self, msg, frames):
+        self._end_lease(msg["lease_id"], back_to_idle=True)
+        return {"ok": True}
+
+    def _h_renew_leases(self, msg, frames):
+        now = time.monotonic()
+        with self._lock:
+            for lid in msg.get("lease_ids", ()):
+                lease = self._leases.get(lid)
+                if lease is not None:
+                    lease.expiry = now + LEASE_TTL_S
+
+    def _end_lease(self, lease_id: bytes, back_to_idle: bool):
+        with self._lock:
+            lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            return
+        w = lease.worker
+        with self._lock:
+            w.lease_id = None
+        self._release_worker_resources(w)
+        if back_to_idle:
+            with self._lock:
+                if w.worker_id in self._workers and w.actor_id is None and \
+                        not w.idle:
+                    w.idle = True
+                    self._idle_workers.append(w)
+        self._dispatch_wake.set()
+
+    def _expire_leases(self):
+        now = time.monotonic()
+        with self._lock:
+            stale = [lid for lid, le in self._leases.items()
+                     if le.expiry < now]
+        for lid in stale:
+            self._end_lease(lid, back_to_idle=True)
 
     def _reap_loop(self):
         """Detect worker-process death (reference: raylet learns of worker
@@ -281,6 +455,7 @@ class Nodelet:
                         self._idle_workers.remove(w)
             for w in dead:
                 self._on_worker_death(w)
+            self._expire_leases()
 
     def _on_worker_death(self, w: _Worker):
         rc = w.proc.returncode
@@ -308,6 +483,21 @@ class Nodelet:
                                  timeout=10)
             except Exception:
                 pass
+        if w.lease_id is not None:
+            # leased worker died: the owner tracks its own in-flight pushes
+            # and resubmits them through the classic scheduling path
+            with self._lock:
+                lease = self._leases.pop(w.lease_id, None)
+                w.lease_id = None
+            if lease is not None and lease.owner:
+                try:
+                    self.client.send_oneway(lease.owner, "lease_broken", {
+                        "lease_id": lease.lease_id,
+                        "worker_id": w.worker_id,
+                        "rc": rc,
+                    })
+                except Exception:
+                    pass
         self._dispatch_wake.set()
 
     def _release_worker_resources(self, w: _Worker):
@@ -785,16 +975,61 @@ class Nodelet:
     # (reference: chunked ObjectBufferPool transfers, object_manager.h:117)
     PULL_CHUNK = property(lambda self: cfg.get("PULL_CHUNK_BYTES"))
 
+    def _h_prefetch_object(self, msg, frames):
+        """Owner-directed push: the submitter tells the execution node to
+        start pulling a large arg BEFORE the task needs it (reference:
+        PushManager proactive transfer, object_manager/push_manager.h:30 —
+        same effect, initiated as a prefetch on the receiver so the
+        existing pull/admission machinery is reused). Best-effort: when
+        admission is saturated the prefetch is simply dropped — it must
+        never park a server thread (the worker's own pull is the
+        fallback)."""
+        oid = msg["oid"]
+        location = msg.get("location")
+        if not location or self.store.contains(oid):
+            return
+        if not self._pull_sem.acquire(blocking=False):
+            return
+        try:
+            self._fetch_object_admitted(oid, location)
+        except Exception:  # noqa: BLE001
+            pass
+        finally:
+            self._pull_sem.release()
+
     def _h_fetch_object(self, msg, frames):
         """Ensure an object is present in the local store, pulling from
         the node given in `location` if needed (reference: PullManager,
-        object_manager/pull_manager.h:52)."""
+        object_manager/pull_manager.h:52). Admission control bounds
+        concurrent inbound transfers so a pull storm cannot oversubscribe
+        memory/NIC (pull_manager.h request queue role); the WAITER count
+        is also bounded so a fetch storm cannot park every RPC handler
+        thread — excess callers get an immediate busy error and fall back
+        to their direct-pull path."""
         oid = msg["oid"]
         if self.store.contains(oid):
             return {"ok": True}
         location = msg.get("location")
         if not location:
             return {"ok": False, "error": "no location"}
+        with self._lock:
+            if self._pull_waiters >= 8:
+                return {"ok": False, "error": "pull admission busy"}
+            self._pull_waiters += 1
+        try:
+            if not self._pull_sem.acquire(timeout=60):
+                return {"ok": False, "error": "pull admission timeout"}
+        finally:
+            with self._lock:
+                self._pull_waiters -= 1
+        try:
+            return self._fetch_object_admitted(oid, location)
+        finally:
+            self._pull_sem.release()
+
+    def _fetch_object_admitted(self, oid, location):
+        if self.store.contains(oid):
+            return {"ok": True}
         meta = self.client.call(location, "object_meta", {"oid": oid},
                                 timeout=15, retries=1)
         if not meta.get("ok"):

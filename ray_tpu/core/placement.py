@@ -56,11 +56,20 @@ def _plan(bundles, strategy, nodes, avail):
     """Return list of node assignments (one per bundle) or None.
 
     `avail` is mutated per-plan (caller passes a copy per attempt).
+
+    Slice-bundle gang semantics (reference:
+    bundle_scheduling_policy.h:82-106 + accelerators/tpu.py:19-44):
+    a multi-bundle TPU request under STRICT_PACK is a SLICE GANG — every
+    bundle lands on a host of ONE pod slice, one bundle per host in
+    TPU_WORKER_ID order when the counts line up (bundle i ⇒ worker i, so
+    train rank i gets the right libtpu process id). SPREAD with TPU
+    prefers distinct slices per bundle (one gang member per DCN domain).
     """
     live = [n for n in nodes]
+    tpu_gang = len(bundles) > 1 and any(b.get("TPU", 0) > 0 for b in bundles)
     if strategy in ("STRICT_PACK", "PACK"):
-        # try to land everything on a single node (slice-aware: group
-        # candidate nodes by slice label and try biggest slices first)
+        # try to land everything on a single node first (a single-host
+        # slice, e.g. v4-8, is the common small case)
         for n in live:
             a = dict(avail.get(n.node_id, {}))
             ok = True
@@ -72,14 +81,17 @@ def _plan(bundles, strategy, nodes, avail):
             if ok:
                 return [n.node_id] * len(bundles)
         if strategy == "STRICT_PACK":
-            # same-slice fallback: all bundles on nodes sharing a slice label
-            by_slice = {}
-            for n in live:
-                sl = n.labels.get("ray.io/tpu-slice")
-                if sl is not None:
-                    by_slice.setdefault(sl, []).append(n)
-            for group in by_slice.values():
-                assign = _spread_over(bundles, group, avail, strict=False)
+            # slice-gang fallback: all bundles on the hosts of ONE slice
+            from ray_tpu.core.tpu import slice_members
+
+            groups = slice_members(live)
+
+            def slice_tpu(members):
+                return sum(avail.get(n.node_id, {}).get("TPU", 0.0)
+                           for n in members)
+
+            for sl in sorted(groups, key=lambda s: -slice_tpu(groups[s])):
+                assign = _gang_over_slice(bundles, groups[sl], avail)
                 if assign is not None:
                     return assign
             return None
@@ -87,28 +99,57 @@ def _plan(bundles, strategy, nodes, avail):
         return _spread_over(bundles, live, avail, strict=False)
     if strategy == "STRICT_SPREAD":
         return _spread_over(bundles, live, avail, strict=True)
-    # SPREAD: best-effort distinct nodes
-    return _spread_over(bundles, live, avail, strict=False, prefer_distinct=True)
+    # SPREAD: best-effort distinct nodes; TPU gangs prefer distinct slices
+    return _spread_over(bundles, live, avail, strict=False,
+                        prefer_distinct=True, prefer_new_slice=tpu_gang)
 
 
-def _spread_over(bundles, nodes, avail, strict, prefer_distinct=True):
+def _gang_over_slice(bundles, members, avail):
+    """Place a gang onto one slice's hosts. `members` is sorted by
+    TPU_WORKER_ID (ray_tpu.core.tpu.slice_members). When there is exactly
+    one bundle per host, bundle i lands on worker i — deterministic
+    rank→host mapping; otherwise best-effort spread within the slice."""
+    if len(bundles) == len(members):
+        remaining = {n.node_id: dict(avail.get(n.node_id, {}))
+                     for n in members}
+        assign = []
+        for b, n in zip(bundles, members):
+            if not _fits(remaining[n.node_id], b):
+                assign = None
+                break
+            _sub(remaining[n.node_id], b)
+            assign.append(n.node_id)
+        if assign is not None:
+            return assign
+    return _spread_over(bundles, members, avail, strict=False)
+
+
+def _spread_over(bundles, nodes, avail, strict, prefer_distinct=True,
+                 prefer_new_slice=False):
     remaining = {n.node_id: dict(avail.get(n.node_id, {})) for n in nodes}
     used = set()
+    used_slices = set()
     assign = []
     for b in bundles:
         placed = None
-        candidates = sorted(nodes, key=lambda n: (n.node_id in used,))
+        if prefer_new_slice:
+            candidates = sorted(nodes, key=lambda n: (
+                n.labels.get("ray.io/tpu-slice") in used_slices,
+                n.node_id in used))
+        else:
+            candidates = sorted(nodes, key=lambda n: (n.node_id in used,))
         for n in candidates:
             if strict and n.node_id in used:
                 continue
             if _fits(remaining[n.node_id], b):
-                placed = n.node_id
+                placed = n
                 break
         if placed is None:
             return None
-        _sub(remaining[placed], b)
-        used.add(placed)
-        assign.append(placed)
+        _sub(remaining[placed.node_id], b)
+        used.add(placed.node_id)
+        used_slices.add(placed.labels.get("ray.io/tpu-slice"))
+        assign.append(placed.node_id)
     return assign
 
 

@@ -29,10 +29,42 @@ def _pad(n: int) -> int:
     return (n + _ALIGN - 1) & ~(_ALIGN - 1)
 
 
+_FAST_LEAF = (int, float, bool, bytes, str, type(None), complex)
+
+
+def _fast_picklable(obj, depth: int = 3) -> bool:
+    """True when plain (C-accelerated) pickle provably behaves like
+    cloudpickle for this object: builtin scalars, numpy/contiguous
+    buffers, and shallow builtin containers of those. Anything that could
+    reference user-defined modules (instances, functions, classes) goes
+    through cloudpickle so register_pickle_by_value semantics hold."""
+    if isinstance(obj, _FAST_LEAF):
+        return True
+    t = type(obj)
+    if t.__module__ == "numpy":
+        # object-dtype arrays hold arbitrary python objects that need
+        # cloudpickle's by-value semantics
+        dt = getattr(obj, "dtype", None)
+        return dt is None or dt.kind != "O"
+    if depth <= 0:
+        return False
+    if t is dict:
+        return all(isinstance(k, _FAST_LEAF) and _fast_picklable(v, depth - 1)
+                   for k, v in obj.items())
+    if t in (list, tuple, set, frozenset):
+        return all(_fast_picklable(v, depth - 1) for v in obj)
+    return False
+
+
 def serialize(obj: Any) -> tuple[bytes, list[memoryview], int]:
     """Returns (header+pickle bytes, out-of-band buffers, total_size)."""
     buffers: list[pickle.PickleBuffer] = []
-    payload = cloudpickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
+    if _fast_picklable(obj):
+        payload = pickle.dumps(obj, protocol=5,
+                               buffer_callback=buffers.append)
+    else:
+        payload = cloudpickle.dumps(obj, protocol=5,
+                                    buffer_callback=buffers.append)
     views = [b.raw() for b in buffers]
     head = struct.pack("<II", _MAGIC, len(views))
     head += struct.pack("<Q", len(payload))
@@ -98,7 +130,16 @@ def loads(data: bytes | memoryview) -> Any:
 
 
 def dumps_msg(obj: Any) -> bytes:
-    """Serialize a small control-plane message (no out-of-band path)."""
+    """Serialize a small control-plane message (no out-of-band path).
+    Plain (C-accelerated) pickle when the payload is provably made of
+    builtin/numpy values — several times faster than cloudpickle on the
+    hot path. Anything that might reference user modules (e.g. task args
+    holding a driver-__main__ class, which plain pickle would serialize
+    by an unresolvable reference) goes through cloudpickle. Sender-side
+    try/except is NOT enough: pickling __main__ classes by reference
+    succeeds here and fails only at the receiver."""
+    if _fast_picklable(obj, depth=8):
+        return pickle.dumps(obj, protocol=5)
     return cloudpickle.dumps(obj, protocol=5)
 
 

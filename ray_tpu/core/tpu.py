@@ -1,0 +1,107 @@
+"""TPU accelerator manager — slice identity, topology env, slice-head marker.
+
+Reference parity: ray._private.accelerators.tpu.TPUAcceleratorManager
+(python/ray/_private/accelerators/tpu.py:19-44 — pod metadata → slice
+identity; :157-170 — TPU_WORKER_ID / TPU_WORKER_HOSTNAMES wiring) and the
+`TPU-{pod_type}-head` marker resource placed on worker 0 of each slice so
+a single task can target "one per slice".
+
+TPU-first design: slice identity is carried as node LABELS
+(`ray.io/tpu-slice`, `ray.io/tpu-worker-id`, ...) that the placement layer
+understands natively — STRICT_PACK gangs land on the hosts of ONE slice,
+one bundle per host in worker-id order; SPREAD gangs prefer distinct
+slices. On real TPU VMs the labels come from the libtpu/GKE environment;
+in tests they are asserted via Cluster.add_node(labels=...).
+"""
+
+from __future__ import annotations
+
+import os
+
+SLICE_LABEL = "ray.io/tpu-slice"
+WORKER_ID_LABEL = "ray.io/tpu-worker-id"
+POD_TYPE_LABEL = "ray.io/tpu-pod-type"
+TOPOLOGY_LABEL = "ray.io/tpu-topology"
+
+
+def detect_slice_labels(environ=None) -> dict[str, str]:
+    """Slice-identity labels from the TPU VM environment, or {} off-pod.
+
+    Sources, in priority order (reference tpu.py:19-44 reads the GCE
+    metadata server / GKE env; this image has zero egress so env vars are
+    the seam — real deployments set them via the pod spec):
+      TPU_NAME / HOSTNAME        -> slice id
+      TPU_WORKER_ID              -> index of this host within the slice
+      TPU_ACCELERATOR_TYPE       -> pod type (e.g. "v4-16")
+      TPU_TOPOLOGY               -> chip topology (e.g. "2x2x2")
+    """
+    env = environ if environ is not None else os.environ
+    labels: dict[str, str] = {}
+    slice_name = env.get("TPU_NAME") or env.get("RAY_TPU_SLICE_NAME")
+    if not slice_name:
+        return labels
+    labels[SLICE_LABEL] = slice_name
+    if env.get("TPU_WORKER_ID") is not None:
+        labels[WORKER_ID_LABEL] = str(env["TPU_WORKER_ID"])
+    if env.get("TPU_ACCELERATOR_TYPE"):
+        labels[POD_TYPE_LABEL] = env["TPU_ACCELERATOR_TYPE"]
+    if env.get("TPU_TOPOLOGY"):
+        labels[TOPOLOGY_LABEL] = env["TPU_TOPOLOGY"]
+    return labels
+
+
+def slice_head_resource(pod_type: str) -> str:
+    """Marker resource asserted on worker 0 of a slice (reference
+    tpu.py: `TPU-{accelerator_type}-head`) so `resources={"TPU-v4-16-head": 1}`
+    schedules exactly one task per slice."""
+    return f"TPU-{pod_type}-head"
+
+
+def head_marker_resources(labels: dict[str, str]) -> dict[str, float]:
+    """Extra resources a node should assert given its slice labels."""
+    if (labels.get(WORKER_ID_LABEL) == "0"
+            and labels.get(POD_TYPE_LABEL)):
+        return {slice_head_resource(labels[POD_TYPE_LABEL]): 1.0}
+    return {}
+
+
+def slice_members(nodes) -> dict[str, list]:
+    """Group node records (anything with .labels) by slice, each group
+    sorted by worker-id so index i == TPU_WORKER_ID i."""
+    groups: dict[str, list] = {}
+    for n in nodes:
+        sl = n.labels.get(SLICE_LABEL)
+        if sl is not None:
+            groups.setdefault(sl, []).append(n)
+    for members in groups.values():
+        members.sort(key=_worker_id)
+    return groups
+
+
+def _worker_id(node) -> int:
+    try:
+        return int(node.labels.get(WORKER_ID_LABEL, 1 << 30))
+    except (TypeError, ValueError):
+        return 1 << 30
+
+
+def topology_env(labels: dict[str, str], slice_ips: list[str],
+                 worker_id: int | None = None) -> dict[str, str]:
+    """The libtpu multi-host env for a worker on a node with these labels
+    (reference: backend_executor.py:306-322 shares the slice view across
+    colocated workers; tpu.py:157-170 derives id/hostnames)."""
+    env: dict[str, str] = {}
+    wid = worker_id
+    if wid is None and labels.get(WORKER_ID_LABEL) is not None:
+        wid = int(labels[WORKER_ID_LABEL])
+    if wid is not None:
+        env["TPU_WORKER_ID"] = str(wid)
+    if slice_ips:
+        env["TPU_WORKER_HOSTNAMES"] = ",".join(slice_ips)
+    if labels.get(POD_TYPE_LABEL):
+        env["TPU_ACCELERATOR_TYPE"] = labels[POD_TYPE_LABEL]
+    if labels.get(TOPOLOGY_LABEL):
+        env["TPU_TOPOLOGY"] = labels[TOPOLOGY_LABEL]
+    if labels.get(SLICE_LABEL):
+        env["TPU_NAME"] = labels[SLICE_LABEL]
+    return env

@@ -47,7 +47,19 @@ class WorkerRuntime(ClusterRuntime):
         self._seen_calls: set[bytes] = set()
         self._seen_calls_order: list[bytes] = []
         self._seen_lock = threading.Lock()
+        # leased-task inbox: owners with a worker lease push tasks here
+        # DIRECTLY (reference: lease reuse + OnWorkerIdle pipelined pushes,
+        # core_worker/transport/normal_task_submitter.cc:137). One serial
+        # executor thread — a lease is one task-slot's worth of CPU.
+        self._task_inbox: _queue.Queue = _queue.Queue()
+        threading.Thread(target=self._task_exec_loop, daemon=True,
+                         name="leased-task-exec").start()
+        self._event_buf: list = []
+        self._event_buf_lock = threading.Lock()
+        threading.Thread(target=self._event_flush_loop, daemon=True,
+                         name="task-event-flush").start()
         self.server.register("execute_task", self._h_execute_task, oneway=True)
+        self.server.register("execute_leased", self._h_execute_leased)
         self.server.register("become_actor", self._h_become_actor, oneway=True)
         self.server.register("actor_call", self._h_actor_call)
         self.server.register("exit_worker", self._h_exit, oneway=True)
@@ -84,11 +96,13 @@ class WorkerRuntime(ClusterRuntime):
                     self.store.seal(b)
                     frames.append(b"")
                     locations.append({"address": self.nodelet_address,
-                                      "store_name": self.store.name})
+                                      "store_name": self.store.name,
+                                      "size": total})
                 except KeyError:
                     frames.append(b"")
                     locations.append({"address": self.nodelet_address,
-                                      "store_name": self.store.name})
+                                      "store_name": self.store.name,
+                                      "size": total})
                 except Exception:
                     buf = bytearray(total)
                     ser.write_into(memoryview(buf), head_payload, views)
@@ -116,22 +130,69 @@ class WorkerRuntime(ClusterRuntime):
 
     def _report_task_event(self, task_id: bytes, name: str, state: str,
                            t0: float, kind: str):
+        """Buffered: per-task oneways to the head would dominate the hot
+        path at >1k tasks/s (reference: task events are batched through
+        the TaskEventBuffer, src/ray/core_worker/task_event_buffer.h)."""
+        ev = {
+            "task_id": task_id.hex(),
+            "name": name,
+            "state": state,
+            "type": kind,
+            "duration_ms": round((time.monotonic() - t0) * 1e3, 2),
+            "worker_id": self.worker_id_bytes.hex(),
+            "node_id": self.node_id.hex() if self.node_id else "",
+            "time": time.time(),
+        }
+        with self._event_buf_lock:
+            self._event_buf.append(ev)
+            flush = len(self._event_buf) >= 200
+        if flush:
+            self._flush_task_events()
+
+    def _flush_task_events(self):
+        with self._event_buf_lock:
+            batch, self._event_buf = self._event_buf, []
+        if not batch:
+            return
         try:
-            self.client.send_oneway(self.head_address, "task_event", {
-                "task_id": task_id.hex(),
-                "name": name,
-                "state": state,
-                "type": kind,
-                "duration_ms": round((time.monotonic() - t0) * 1e3, 2),
-                "worker_id": self.worker_id_bytes.hex(),
-                "node_id": self.node_id.hex() if self.node_id else "",
-                "time": time.time(),
-            })
+            self.client.send_oneway(self.head_address, "task_events",
+                                    {"events": batch})
         except Exception:
             pass
 
+    def _event_flush_loop(self):
+        while True:
+            time.sleep(1.0)
+            self._flush_task_events()
+
     def _h_execute_task(self, msg, frames):
-        spec = TaskSpec(**msg["spec"])
+        self._exec_task_spec(TaskSpec(**msg["spec"]), notify_nodelet=True)
+
+    def _h_execute_leased(self, msg, frames):
+        """Enqueue-ack for a direct leased push. Dedup by (task_id,
+        attempt): the owner's submit sweeper may resend after a slow ack."""
+        key = msg["spec"]["task_id"] + bytes([msg.get("attempt", 0) & 0xFF])
+        with self._seen_lock:
+            if key in self._seen_calls:
+                return {"queued": True, "duplicate": True}
+            self._seen_calls.add(key)
+            self._seen_calls_order.append(key)
+            if len(self._seen_calls_order) > 20000:
+                for old in self._seen_calls_order[:10000]:
+                    self._seen_calls.discard(old)
+                del self._seen_calls_order[:10000]
+        self._task_inbox.put(msg)
+        return {"queued": True}
+
+    def _task_exec_loop(self):
+        while True:
+            msg = self._task_inbox.get()
+            if msg is None:
+                return
+            self._exec_task_spec(TaskSpec(**msg["spec"]),
+                                 notify_nodelet=False)
+
+    def _exec_task_spec(self, spec: TaskSpec, notify_nodelet: bool):
         self._ctx.task_id = TaskID(spec.task_id)
         t_start = time.monotonic()
         try:
@@ -162,11 +223,13 @@ class WorkerRuntime(ClusterRuntime):
                                     t_start, "NORMAL_TASK")
         finally:
             self._ctx.task_id = None
-            try:
-                self.client.send_oneway(self.nodelet_address, "task_finished",
-                                        {"worker_id": self.worker_id_bytes})
-            except Exception:
-                pass
+            if notify_nodelet:
+                try:
+                    self.client.send_oneway(self.nodelet_address,
+                                            "task_finished",
+                                            {"worker_id": self.worker_id_bytes})
+                except Exception:
+                    pass
 
     # ------------------------------------------------------------ actors
 

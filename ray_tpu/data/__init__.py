@@ -2,18 +2,32 @@
 
 Reference parity: ray.data (python/ray/data/) — lazy plans, block-based
 streaming execution with bounded in-flight work, map/map_batches/filter
-transforms, actor-pool compute, per-shard Train ingestion.
+transforms, actor-pool compute, all-to-all exchanges (random_shuffle /
+sort / groupby-aggregate), Arrow-backed parquet IO, per-shard Train
+ingestion.
 """
 
 from ray_tpu.data.dataset import (
+    AggregateFn,
+    Count,
     Dataset,
+    GroupedData,
+    Max,
+    Mean,
+    Min,
+    Std,
+    Sum,
+    from_arrow,
     from_items,
     from_numpy,
     range,
     read_csv,
     read_json,
+    read_parquet,
     read_text,
 )
 
-__all__ = ["Dataset", "from_items", "from_numpy", "range",
-           "read_csv", "read_json", "read_text"]
+__all__ = ["AggregateFn", "Count", "Dataset", "GroupedData", "Max",
+           "Mean", "Min", "Std", "Sum", "from_arrow", "from_items",
+           "from_numpy", "range", "read_csv", "read_json",
+           "read_parquet", "read_text"]

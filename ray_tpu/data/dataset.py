@@ -85,6 +85,13 @@ class Dataset:
             if batch_format == "numpy":
                 out = fn(rows_to_batch(block))
                 return batch_to_rows(out)
+            if batch_format == "pyarrow":
+                import pyarrow as pa
+
+                rows = [r if isinstance(r, dict) else {"value": r}
+                        for r in block]
+                out = fn(pa.Table.from_pylist(rows))
+                return out.to_pylist()
             out = fn(block)
             return list(out)
 
@@ -97,6 +104,48 @@ class Dataset:
     def repartition(self, num_blocks: int) -> "Dataset":
         rows = self.take_all()
         return Dataset.from_items(rows, num_blocks)
+
+    # ---------------------------------------------------------- all-to-all
+
+    def _out_partitions(self, num_blocks: int | None) -> int:
+        return max(1, num_blocks or len(self._block_refs))
+
+    def random_shuffle(self, *, seed: int | None = None,
+                       num_blocks: int | None = None) -> "Dataset":
+        """Global row shuffle via a map/partition/reduce exchange
+        (reference: Dataset.random_shuffle, data/dataset.py:1374)."""
+        from ray_tpu.data.exchange import shuffle_exchange
+
+        refs = shuffle_exchange(self._block_refs, _fuse(self._ops),
+                                self._out_partitions(num_blocks), seed)
+        return Dataset(refs)
+
+    def sort(self, key=None, descending: bool = False,
+             num_blocks: int | None = None) -> "Dataset":
+        """Distributed sample-partitioned sort (reference: Dataset.sort,
+        data/dataset.py:2472). `key` is a column name, a callable, or
+        None for the row itself."""
+        from ray_tpu.data.exchange import sort_exchange
+
+        refs = sort_exchange(self._block_refs, _fuse(self._ops),
+                             self._out_partitions(num_blocks), key,
+                             descending)
+        ds = Dataset(refs)
+        ds._sorted_desc = descending  # type: ignore[attr-defined]
+        return ds
+
+    def groupby(self, key) -> "GroupedData":
+        """Hash-partitioned groupby (reference: Dataset.groupby,
+        data/dataset.py:2099 -> GroupedData)."""
+        return GroupedData(self, key)
+
+    def unique(self, key=None) -> list:
+        from ray_tpu.data.exchange import groupby_exchange
+
+        refs = groupby_exchange(self._block_refs, _fuse(self._ops),
+                                self._out_partitions(None), key,
+                                lambda k, rows: k)
+        return [v for r in Dataset(refs).iter_rows() for v in [r]]
 
     def shard(self, num_shards: int, index: int) -> "Dataset":
         """Deterministic block-wise shard (per-host Train ingestion)."""
@@ -180,14 +229,25 @@ class Dataset:
                      batch_format: str = "numpy") -> Iterator:
         """Re-batch across block boundaries (reference:
         data/_internal/iterator/)."""
+        def fmt(rows):
+            if batch_format == "numpy":
+                return rows_to_batch(rows)
+            if batch_format == "pyarrow":
+                import pyarrow as pa
+
+                return pa.Table.from_pylist(
+                    [r if isinstance(r, dict) else {"value": r}
+                     for r in rows])
+            return rows
+
         buf: list = []
         for row in self.iter_rows():
             buf.append(row)
             if len(buf) >= batch_size:
-                yield rows_to_batch(buf) if batch_format == "numpy" else buf
+                yield fmt(buf)
                 buf = []
         if buf:
-            yield rows_to_batch(buf) if batch_format == "numpy" else buf
+            yield fmt(buf)
 
     def take(self, n: int = 20) -> list:
         out = []
@@ -214,6 +274,27 @@ class Dataset:
     def sum(self) -> Any:
         return sum(self.iter_rows())
 
+    def write_parquet(self, directory: str) -> list[str]:
+        """One parquet file per block via Arrow (reference:
+        Dataset.write_parquet)."""
+        import os as _os
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        import ray_tpu
+
+        _os.makedirs(directory, exist_ok=True)
+        paths = []
+        for i, ref in enumerate(self._execute()):
+            block = ray_tpu.get(ref, timeout=600)
+            path = _os.path.join(directory, f"part-{i:05d}.parquet")
+            rows = [r if isinstance(r, dict) else {"value": r}
+                    for r in block]
+            pq.write_table(pa.Table.from_pylist(rows), path)
+            paths.append(path)
+        return paths
+
     def write_jsonl(self, directory: str) -> list[str]:
         """One output file per block (reference: write_* produce one
         file per block/task)."""
@@ -236,6 +317,108 @@ class Dataset:
     def __repr__(self):
         ops = "->".join(o.kind for o in self._ops) or "source"
         return f"Dataset(blocks={len(self._block_refs)}, plan={ops})"
+
+
+class AggregateFn:
+    """A named aggregation over a group's rows (reference:
+    ray.data.aggregate.AggregateFn — here list-at-once instead of
+    accumulate/merge, proportionate to block-resident groups)."""
+
+    def __init__(self, name: str, fn: Callable[[list], Any]):
+        self.name = name
+        self.fn = fn
+
+
+def Count() -> AggregateFn:  # noqa: N802 — reference-parity naming
+    return AggregateFn("count", len)
+
+
+def Sum(col=None) -> AggregateFn:  # noqa: N802
+    return AggregateFn(f"sum({col})" if col else "sum",
+                       lambda rows: sum(_col(rows, col)))
+
+
+def Mean(col=None) -> AggregateFn:  # noqa: N802
+    return AggregateFn(f"mean({col})" if col else "mean",
+                       lambda rows: sum(_col(rows, col)) / len(rows))
+
+
+def Min(col=None) -> AggregateFn:  # noqa: N802
+    return AggregateFn(f"min({col})" if col else "min",
+                       lambda rows: min(_col(rows, col)))
+
+
+def Max(col=None) -> AggregateFn:  # noqa: N802
+    return AggregateFn(f"max({col})" if col else "max",
+                       lambda rows: max(_col(rows, col)))
+
+
+def Std(col=None) -> AggregateFn:  # noqa: N802
+    def std(rows):
+        vals = list(_col(rows, col))
+        m = sum(vals) / len(vals)
+        return (sum((v - m) ** 2 for v in vals) / max(1, len(vals) - 1)) ** 0.5
+
+    return AggregateFn(f"std({col})" if col else "std", std)
+
+
+def _col(rows, col):
+    return (r[col] for r in rows) if col is not None else rows
+
+
+class GroupedData:
+    """Reference parity: ray.data.grouped_data.GroupedData — the result
+    of Dataset.groupby; aggregations run as the reduce side of a hash
+    exchange."""
+
+    def __init__(self, ds: Dataset, key):
+        self._ds = ds
+        self._key = key
+
+    def _exchange(self, group_reducer) -> Dataset:
+        from ray_tpu.data.exchange import groupby_exchange
+
+        refs = groupby_exchange(
+            self._ds._block_refs, _fuse(self._ds._ops),
+            self._ds._out_partitions(None), self._key, group_reducer)
+        return Dataset(refs)
+
+    def aggregate(self, *aggs: AggregateFn) -> Dataset:
+        key_name = self._key if isinstance(self._key, str) else "key"
+        names = [a.name for a in aggs]
+        fns = [a.fn for a in aggs]
+
+        def reduce_group(k, rows):
+            out = {key_name: k}
+            for name, fn in zip(names, fns):
+                out[name] = fn(rows)
+            return out
+
+        return self._exchange(reduce_group)
+
+    def count(self) -> Dataset:
+        return self.aggregate(Count())
+
+    def sum(self, col=None) -> Dataset:
+        return self.aggregate(Sum(col))
+
+    def mean(self, col=None) -> Dataset:
+        return self.aggregate(Mean(col))
+
+    def min(self, col=None) -> Dataset:
+        return self.aggregate(Min(col))
+
+    def max(self, col=None) -> Dataset:
+        return self.aggregate(Max(col))
+
+    def std(self, col=None) -> Dataset:
+        return self.aggregate(Std(col))
+
+    def map_groups(self, fn: Callable[[list], Any]) -> Dataset:
+        """fn(rows_of_one_group) -> output row(s); lists are flattened
+        (reference: GroupedData.map_groups)."""
+        ds = self._exchange(lambda k, rows: fn(rows))
+        return ds.flat_map(lambda r: r if isinstance(r, list) else [r])
 
 
 def from_items(items, parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
@@ -321,3 +504,25 @@ def read_json(paths) -> Dataset:
         return out
 
     return _read_source(paths, rd)
+
+
+def read_parquet(paths, columns: list[str] | None = None) -> Dataset:
+    """Columnar parquet read — one Arrow table per file, read inside
+    tasks (reference: ray.data.read_parquet backed by
+    data/_internal/arrow_block.py). Rows surface as dicts; use
+    map_batches(batch_format="pyarrow") to stay columnar."""
+
+    def rd(block):
+        import pyarrow.parquet as pq
+
+        out = []
+        for path in block:
+            out.extend(pq.read_table(path, columns=columns).to_pylist())
+        return out
+
+    return _read_source(paths, rd)
+
+
+def from_arrow(table, parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
+    """Dataset from a pyarrow Table (reference: ray.data.from_arrow)."""
+    return Dataset.from_items(table.to_pylist(), parallelism)

@@ -1,0 +1,153 @@
+"""All-to-all block exchange: shuffle / sort / groupby.
+
+Reference parity: ray.data's all-to-all operators —
+`random_shuffle` (python/ray/data/dataset.py:1374), `sort` (:2472) and
+`groupby` (:2099), executed as the shuffle pattern in
+data/_internal/planner/exchange/ (ShuffleTaskSpec / SortTaskSpec:
+map tasks partition each block into P sub-blocks, reduce tasks merge
+the p-th sub-block of every map output). Here the exchange rides the
+task runtime's multi-return objects: every map task returns P
+sub-blocks through the shared-memory object store; reduce tasks take
+the p-th output of each map as args — arg locality pulls each reduce
+to the node holding most of its inputs.
+
+Sort uses sample-based range partitioning (reference:
+SortTaskSpec.sample_boundaries) so output blocks are globally ordered.
+"""
+
+from __future__ import annotations
+
+import bisect
+import pickle
+import zlib
+from typing import Any, Callable
+
+
+def _stable_hash(key) -> int:
+    """Process-stable hash for partitioning. Python's hash() is salted
+    per process (PYTHONHASHSEED) — map tasks run in different worker
+    processes, so salted hashes would scatter one group's rows across
+    reduce partitions."""
+    if isinstance(key, int):
+        return key
+    return zlib.crc32(pickle.dumps(key, protocol=5))
+
+
+def exchange(block_refs: list, fused: Callable[[list], list],
+             num_partitions: int,
+             partitioner: Callable[[list], list[list]],
+             reducer: Callable[[list[list]], list]) -> list:
+    """Run the two-stage exchange; returns refs of P reduced blocks."""
+    import ray_tpu
+
+    P = max(1, num_partitions)
+
+    @ray_tpu.remote(num_cpus=1, num_returns=P)
+    def _map(block):
+        parts = partitioner(fused(block))
+        return tuple(parts) if P > 1 else parts[0]
+
+    @ray_tpu.remote(num_cpus=1)
+    def _reduce(*parts):
+        return reducer(list(parts))
+
+    map_outs = [_map.remote(ref) for ref in block_refs]
+    if P == 1:
+        map_outs = [[r] for r in map_outs]
+    return [_reduce.remote(*[m[p] for m in map_outs]) for p in range(P)]
+
+
+# ---------------------------------------------------------------- shuffle
+
+def shuffle_exchange(block_refs, fused, num_partitions, seed=None):
+    import numpy as _np
+
+    def partitioner(rows):
+        rng = _np.random.default_rng(seed)
+        buckets: list[list] = [[] for _ in range(num_partitions)]
+        if rows:
+            for row, b in zip(rows, rng.integers(0, num_partitions,
+                                                 len(rows))):
+                buckets[int(b)].append(row)
+        return buckets
+
+    def reducer(parts):
+        rows = [r for part in parts for r in part]
+        rng = _np.random.default_rng(None if seed is None else seed + 1)
+        rng.shuffle(rows)
+        return rows
+
+    return exchange(block_refs, fused, num_partitions, partitioner, reducer)
+
+
+# ---------------------------------------------------------------- sort
+
+def _key_fn(key) -> Callable[[Any], Any]:
+    if key is None:
+        return lambda r: r
+    if callable(key):
+        return key
+    return lambda r: r[key]
+
+
+def sort_exchange(block_refs, fused, num_partitions, key=None,
+                  descending=False):
+    """Range-partitioned sort: sample keys -> boundaries -> partition ->
+    per-partition local sort. Emitting partitions in boundary order makes
+    the concatenation globally sorted."""
+    import ray_tpu
+
+    kf = _key_fn(key)
+
+    @ray_tpu.remote(num_cpus=1)
+    def _sample(block):
+        rows = fused(block)
+        step = max(1, len(rows) // 64)
+        return [kf(r) for r in rows[::step]]
+
+    samples = sorted(
+        s for out in ray_tpu.get([_sample.remote(r) for r in block_refs],
+                                 timeout=600)
+        for s in out)
+    P = max(1, min(num_partitions, len(samples) or 1))
+    boundaries = [samples[int(len(samples) * (i + 1) / P)]
+                  for i in range(P - 1)] if samples else []
+
+    def partitioner(rows):
+        buckets: list[list] = [[] for _ in range(P)]
+        for r in rows:
+            buckets[bisect.bisect_right(boundaries, kf(r))].append(r)
+        return buckets
+
+    def reducer(parts):
+        rows = [r for part in parts for r in part]
+        rows.sort(key=kf, reverse=descending)
+        return rows
+
+    refs = exchange(block_refs, fused, P, partitioner, reducer)
+    return list(reversed(refs)) if descending else refs
+
+
+# ---------------------------------------------------------------- groupby
+
+def groupby_exchange(block_refs, fused, num_partitions, key,
+                     group_reducer: Callable[[Any, list], Any]):
+    """Hash-partition rows by key; apply `group_reducer(key, rows)` to
+    each group. Output rows ordered by key within each block."""
+    kf = _key_fn(key)
+
+    def partitioner(rows):
+        buckets: list[list] = [[] for _ in range(num_partitions)]
+        for r in rows:
+            buckets[_stable_hash(kf(r)) % num_partitions].append(r)
+        return buckets
+
+    def reducer(parts):
+        groups: dict = {}
+        for part in parts:
+            for r in part:
+                groups.setdefault(kf(r), []).append(r)
+        return [group_reducer(k, rows)
+                for k, rows in sorted(groups.items(), key=lambda kv: kv[0])]
+
+    return exchange(block_refs, fused, num_partitions, partitioner, reducer)

@@ -20,8 +20,9 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
 # Canonical axis names. Order matters: the slowest-varying axis should be
-# the one crossing DCN (data), the fastest-varying ones (tensor/seq) need
-# the highest bandwidth and should map to adjacent ICI neighbors.
+# the one crossing DCN (dcn/data), the fastest-varying ones (tensor/seq)
+# need the highest bandwidth and should map to adjacent ICI neighbors.
+AXIS_DCN = "dcn"  # across pod slices (data-parallel only; low bandwidth)
 AXIS_DATA = "data"
 AXIS_FSDP = "fsdp"
 AXIS_PIPE = "pipe"
@@ -31,6 +32,7 @@ AXIS_TENSOR = "tensor"
 
 # Canonical order from outermost (DCN-friendly) to innermost (ICI-hungry).
 CANONICAL_AXIS_ORDER = (
+    AXIS_DCN,
     AXIS_DATA,
     AXIS_PIPE,
     AXIS_FSDP,
@@ -40,7 +42,7 @@ CANONICAL_AXIS_ORDER = (
 )
 
 # Batch-like activation dimensions are sharded over every replica-ish axis.
-BATCH_AXES = (AXIS_DATA, AXIS_FSDP)
+BATCH_AXES = (AXIS_DCN, AXIS_DATA, AXIS_FSDP)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -55,9 +57,11 @@ class MeshSpec:
     expert: int = 1
     seq: int = 1
     tensor: int = 1
+    dcn: int = 1  # number of pod slices (outermost, data-parallel only)
 
     def resolve(self, n_devices: int) -> dict[str, int]:
         sizes = {
+            AXIS_DCN: self.dcn,
             AXIS_DATA: self.data,
             AXIS_PIPE: self.pipe,
             AXIS_FSDP: self.fsdp,
@@ -106,10 +110,24 @@ def build_mesh(
     shape = tuple(sizes[a] for a in names)
     if math.prod(shape) != len(devices):
         raise ValueError(f"mesh shape {shape} != {len(devices)} devices")
-    try:
-        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
-    except (ValueError, NotImplementedError):
-        dev_array = np.array(devices).reshape(shape)
+    dev_array = None
+    n_slices = sizes.get(AXIS_DCN, 1)
+    if n_slices > 1 and len(slice_groups(devices)) == n_slices:
+        # 2-level hybrid mesh: the dcn axis crosses slice boundaries
+        # (DCN links), every other axis stays within a slice (ICI) —
+        # "How to Scale Your Model" multislice recipe.
+        ici_shape = tuple(1 if a == AXIS_DCN else sizes[a] for a in names)
+        dcn_shape = tuple(n_slices if a == AXIS_DCN else 1 for a in names)
+        try:
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices)
+        except (ValueError, NotImplementedError):
+            dev_array = None
+    if dev_array is None:
+        try:
+            dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+        except (ValueError, NotImplementedError):
+            dev_array = np.array(devices).reshape(shape)
     return Mesh(dev_array, names)
 
 

@@ -195,6 +195,21 @@ class JaxTrainer:
                 if i["node_id"] not in _seen_nodes:
                     _seen_nodes.add(i["node_id"])
                     node_ips.append(i["ip"])
+            # slice-identity view: node labels + per-node IPs, for the
+            # slice-derived topology env (reference: backend_executor.py
+            # :306-322 shares the slice view across colocated workers)
+            node_labels: dict[str, dict] = {}
+            node_ip_by_id: dict[str, str] = {}
+            if sc.use_tpu:
+                import ray_tpu as _rt
+
+                try:
+                    for n in _rt.nodes():
+                        node_labels[n["NodeID"]] = n.get("Labels") or {}
+                except Exception:  # local mode: no cluster view
+                    pass
+                for i in infos:
+                    node_ip_by_id.setdefault(i["node_id"], i["ip"])
             env_refs = []
             for rank, info in enumerate(infos):
                 node_id = info["node_id"]
@@ -209,9 +224,16 @@ class JaxTrainer:
                     # TPUAcceleratorManager worker-id/hostnames wiring,
                     # _private/accelerators/tpu.py:157-170). Per HOST,
                     # not per worker: multiple train workers can share a
-                    # TPU host.
-                    env["TPU_WORKER_ID"] = node_order.index(node_id)
-                    env["TPU_WORKER_HOSTNAMES"] = ",".join(node_ips)
+                    # TPU host. When the node carries slice labels, the
+                    # worker id / hostnames come from SLICE identity
+                    # (worker-id order), not gang join order.
+                    from ray_tpu.core import tpu as tpu_mod
+
+                    labels = node_labels.get(node_id, {})
+                    env.update(self._slice_topology_env(
+                        tpu_mod, labels, node_id, node_labels, node_ip_by_id,
+                        fallback_id=node_order.index(node_id),
+                        fallback_ips=node_ips))
                 if coordinator:
                     env["RAY_TPU_TRAIN_COORDINATOR"] = coordinator
                 env_refs.append((rank, env))
@@ -259,6 +281,32 @@ class JaxTrainer:
                 from e
 
     # ------------------------------------------------------------------
+
+    @staticmethod
+    def _slice_topology_env(tpu_mod, labels, node_id, node_labels,
+                            node_ip_by_id, fallback_id, fallback_ips):
+        """TPU topology env for one worker. Slice-labelled nodes get their
+        asserted TPU_WORKER_ID and hostnames ordered by worker-id across
+        the gang's members of the same slice; unlabelled clusters fall
+        back to gang join order (single-slice assumption)."""
+        sl = labels.get(tpu_mod.SLICE_LABEL)
+        if sl is None or labels.get(tpu_mod.WORKER_ID_LABEL) is None:
+            return {"TPU_WORKER_ID": fallback_id,
+                    "TPU_WORKER_HOSTNAMES": ",".join(fallback_ips)}
+        members = sorted(
+            ((int(lb[tpu_mod.WORKER_ID_LABEL]), nid)
+             for nid, lb in node_labels.items()
+             if lb.get(tpu_mod.SLICE_LABEL) == sl
+             and lb.get(tpu_mod.WORKER_ID_LABEL) is not None
+             and nid in node_ip_by_id))
+        slice_ips = [node_ip_by_id[nid] for _, nid in members]
+        # libtpu requires worker ids to index the hostname list 0..n-1.
+        # A gang covering the FULL slice keeps the asserted ids; a gang on
+        # a subset of hosts is reindexed by position (self-consistent
+        # contiguous view of the sub-slice).
+        position = next((i for i, (_, nid) in enumerate(members)
+                         if nid == node_id), 0)
+        return tpu_mod.topology_env(labels, slice_ips, worker_id=position)
 
     def _result_loop(self, wg: WorkerGroup, manager: CheckpointManager,
                      history: list) -> tuple[dict, Checkpoint | None]:

@@ -2,10 +2,16 @@
 
 Reference parity: ray.tune (python/ray/tune/) — Tuner.fit over actor
 trials with search spaces, random/grid generation, ASHA early stopping,
-and on-disk experiment state with restore.
+Population Based Training (checkpoint exploit + hyperparam explore),
+median stopping, and on-disk experiment state with restore.
 """
 
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+)
 from ray_tpu.tune.search import (
     choice,
     grid_search,
@@ -18,17 +24,21 @@ from ray_tpu.tune.tuner import (
     TuneConfig,
     Tuner,
     TuneResult,
+    get_checkpoint,
     report,
 )
 
 __all__ = [
     "ASHAScheduler",
     "FIFOScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
     "ResultGrid",
     "TuneConfig",
     "TuneResult",
     "Tuner",
     "choice",
+    "get_checkpoint",
     "grid_search",
     "loguniform",
     "randint",

@@ -74,3 +74,155 @@ class ASHAScheduler:
 
     def on_trial_complete(self, trial_id: str):
         self._trial_progress.pop(trial_id, None)
+
+
+class MedianStoppingRule:
+    """Stop a trial whose running-average metric at step t is worse than
+    the median of the other trials' running averages at t (reference:
+    ray.tune.schedulers.MedianStoppingRule, median_stopping_rule.py)."""
+
+    def __init__(self, metric: str | None = None, mode: str | None = None,
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._sums: dict[str, float] = {}
+        self._counts: dict[str, int] = {}
+
+    def set_objective(self, metric: str, mode: str):
+        self.metric = self.metric or metric
+        self.mode = self.mode or mode
+
+    def on_result(self, trial_id: str, result: dict) -> str:
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        self._sums[trial_id] = self._sums.get(trial_id, 0.0) + float(value)
+        self._counts[trial_id] = self._counts.get(trial_id, 0) + 1
+        if t <= self.grace_period:
+            return CONTINUE
+        others = [self._sums[k] / self._counts[k]
+                  for k in self._sums if k != trial_id]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        med = sorted(others)[len(others) // 2]
+        mine = self._sums[trial_id] / self._counts[trial_id]
+        worse = mine < med if self.mode == "max" else mine > med
+        return STOP if worse else CONTINUE
+
+    def on_trial_complete(self, trial_id: str):
+        pass
+
+
+class PopulationBasedTraining:
+    """PBT: bottom-quantile trials clone a top-quantile trial's checkpoint
+    and perturb its hyperparams (reference:
+    ray.tune.schedulers.pbt.PopulationBasedTraining, pbt.py:221 —
+    _checkpoint_or_exploit / _exploit / explore).
+
+    The Tuner acts on the ("EXPLOIT", source_trial_id, new_config)
+    decision by restarting the trial's actor from the source trial's
+    latest reported checkpoint with the mutated config.
+    """
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: str | None = None, mode: str | None = None,
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: dict | None = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 perturbation_factors=(1.2, 0.8),
+                 seed: int | None = None):
+        import random
+
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = dict(hyperparam_mutations or {})
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self.factors = perturbation_factors
+        self._rng = random.Random(seed)
+        self._scores: dict[str, float] = {}   # latest metric per trial
+        self._configs: dict[str, dict] = {}
+        self._last_perturb: dict[str, int] = {}
+        self._pending_exploit: dict[str, tuple] = {}
+        self.exploit_count = 0  # observability / tests
+
+    def set_objective(self, metric: str, mode: str):
+        self.metric = self.metric or metric
+        self.mode = self.mode or mode
+
+    def on_trial_add(self, trial_id: str, config: dict):
+        self._configs[trial_id] = dict(config)
+
+    def on_result(self, trial_id: str, result: dict):
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        self._scores[trial_id] = float(value)
+        if t - self._last_perturb.get(trial_id, 0) < self.interval:
+            return CONTINUE
+        prev_perturb = self._last_perturb.get(trial_id, 0)
+        self._last_perturb[trial_id] = t
+        lower, upper = self._quantiles()
+        if trial_id not in lower or not upper:
+            return CONTINUE
+        source = self._rng.choice(upper)
+        new_config = self._explore(self._configs.get(source, {}))
+        # remember pre-exploit state: the Tuner aborts the exploit when
+        # the source has no checkpoint yet, and scheduler state must then
+        # match the trial's ACTUAL (unchanged) config
+        self._pending_exploit[trial_id] = (
+            dict(self._configs.get(trial_id, {})), prev_perturb)
+        self._configs[trial_id] = dict(new_config)
+        self.exploit_count += 1
+        return ("EXPLOIT", source, new_config)
+
+    def on_exploit_applied(self, trial_id: str):
+        self._pending_exploit.pop(trial_id, None)
+
+    def on_exploit_aborted(self, trial_id: str):
+        """The Tuner could not apply the exploit (no source checkpoint):
+        roll back config + perturbation clock."""
+        saved = self._pending_exploit.pop(trial_id, None)
+        if saved is not None:
+            old_config, old_perturb = saved
+            self._configs[trial_id] = old_config
+            self._last_perturb[trial_id] = old_perturb
+            self.exploit_count -= 1
+
+    def _quantiles(self):
+        """(bottom, top) trial-id lists by latest score."""
+        if len(self._scores) < 2:
+            return [], []
+        ranked = sorted(self._scores, key=self._scores.get,
+                        reverse=(self.mode == "max"))
+        k = max(1, int(len(ranked) * self.quantile))
+        return ranked[-k:], ranked[:k]
+
+    def _explore(self, config: dict) -> dict:
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if isinstance(spec, (list, tuple)):
+                out[key] = self._rng.choice(list(spec))
+                continue
+            if callable(spec):
+                out[key] = spec()
+                continue
+            cur = out.get(key)
+            if isinstance(cur, (int, float)) and \
+                    self._rng.random() >= self.resample_prob:
+                out[key] = cur * self._rng.choice(self.factors)
+                if isinstance(cur, int):
+                    out[key] = max(1, int(out[key]))
+        return out
+
+    def on_trial_complete(self, trial_id: str):
+        self._scores.pop(trial_id, None)

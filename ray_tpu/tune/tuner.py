@@ -31,20 +31,28 @@ _trial_session = None
 
 
 class _TrialSession:
-    def __init__(self):
+    def __init__(self, restored_checkpoint=None):
         # small bound keeps fast trainables in rough lockstep with the
-        # controller so scheduler decisions (ASHA cuts) apply mid-flight
-        # instead of after the trial already finished
+        # controller so scheduler decisions (ASHA cuts, PBT exploits)
+        # apply mid-flight instead of after the trial already finished
         self.results: queue.Queue = queue.Queue(maxsize=2)
         self.iteration = 0
         self.stopped = threading.Event()
+        self.restored_checkpoint = restored_checkpoint
+        self.latest_checkpoint = None
+        self.ckpt_lock = threading.Lock()
 
-    def report(self, metrics: dict):
+    def report(self, metrics: dict, checkpoint=None):
         if self.stopped.is_set():
             raise _StopTrial()
         self.iteration += 1
         m = dict(metrics)
         m.setdefault("training_iteration", self.iteration)
+        if checkpoint is not None:
+            # PBT exploit clones this state into another trial
+            # (reference: pbt.py _exploit via trial checkpoints)
+            with self.ckpt_lock:
+                self.latest_checkpoint = cloudpickle.dumps(checkpoint)
         while True:
             try:
                 self.results.put(m, timeout=0.1)
@@ -60,20 +68,31 @@ class _StopTrial(BaseException):
     swallow it — reference uses the session's StopIteration channel)."""
 
 
-def report(metrics: dict, **kwargs):
-    """ray_tpu.tune.report — inside a trainable."""
+def report(metrics: dict, checkpoint=None, **kwargs):
+    """ray_tpu.tune.report — inside a trainable. `checkpoint` may be any
+    picklable state; PBT clones it into exploited trials."""
     if _trial_session is None:
         raise RuntimeError("tune.report() outside a trial")
-    _trial_session.report(metrics)
+    _trial_session.report(metrics, checkpoint=checkpoint)
+
+
+def get_checkpoint():
+    """Inside a trainable: the checkpoint this trial was (re)started from
+    (None on a fresh start; set after a PBT exploit or restore)."""
+    if _trial_session is None:
+        raise RuntimeError("tune.get_checkpoint() outside a trial")
+    return _trial_session.restored_checkpoint
 
 
 class TrialActor:
     """Hosts one trial: runs the trainable on a thread, serves polling."""
 
-    def __init__(self, trial_id: str, fn_blob: bytes, config: dict):
+    def __init__(self, trial_id: str, fn_blob: bytes, config: dict,
+                 ckpt_blob: bytes | None = None):
         global _trial_session
         self.trial_id = trial_id
-        self.session = _TrialSession()
+        restored = cloudpickle.loads(ckpt_blob) if ckpt_blob else None
+        self.session = _TrialSession(restored_checkpoint=restored)
         _trial_session = self.session
         self.error: str | None = None
         self.finished = threading.Event()
@@ -105,7 +124,11 @@ class TrialActor:
                     break
                 time.sleep(0.02)
         done = self.finished.is_set() and self.session.results.empty()
-        return {"results": out, "done": done, "error": self.error}
+        with self.session.ckpt_lock:
+            ckpt = self.session.latest_checkpoint
+            self.session.latest_checkpoint = None  # ship each blob once
+        return {"results": out, "done": done, "error": self.error,
+                "checkpoint": ckpt}
 
     def stop(self):
         self.session.stopped.set()
@@ -279,6 +302,7 @@ class Tuner:
 
         pending = [t for t in trials if t.status == Trial.PENDING]
         running: list[Trial] = []
+        ckpts: dict[str, bytes] = {}  # trial_id -> latest checkpoint blob
         self._save_state(trials)
         while pending or running:
             while pending and len(running) < limit:
@@ -287,6 +311,8 @@ class Tuner:
                     max_concurrency=2).remote(t.trial_id, fn_blob, t.config)
                 t.status = Trial.RUNNING
                 running.append(t)
+                if hasattr(scheduler, "on_trial_add"):
+                    scheduler.on_trial_add(t.trial_id, t.config)
             refs = {t.trial_id: t.actor.poll.remote() for t in running}
             for t in list(running):
                 try:
@@ -297,16 +323,44 @@ class Tuner:
                     running.remove(t)
                     scheduler.on_trial_complete(t.trial_id)
                     continue
+                if r.get("checkpoint"):
+                    ckpts[t.trial_id] = r["checkpoint"]
                 decision = CONTINUE
                 for m in r["results"]:
                     t.last_result = m
-                    if scheduler.on_result(t.trial_id, m) == STOP:
+                    d = scheduler.on_result(t.trial_id, m)
+                    if d == STOP:
                         decision = STOP
+                    elif isinstance(d, tuple) and d[0] == "EXPLOIT":
+                        decision = d
                 if r["error"]:
                     t.status = Trial.ERROR
                     t.error = r["error"]
                 elif r["done"]:
                     t.status = Trial.TERMINATED
+                elif isinstance(decision, tuple):
+                    # PBT exploit: restart this trial from the source
+                    # trial's checkpoint with the mutated config
+                    # (reference: pbt.py _exploit)
+                    _, source_id, new_config = decision
+                    src_ckpt = ckpts.get(source_id)
+                    if src_ckpt is None:
+                        # no source checkpoint yet: tell the scheduler so
+                        # its config view matches the unchanged trial
+                        if hasattr(scheduler, "on_exploit_aborted"):
+                            scheduler.on_exploit_aborted(t.trial_id)
+                    else:
+                        try:
+                            ray_tpu.kill(t.actor)
+                        except Exception:  # noqa: BLE001
+                            pass
+                        t.config = new_config
+                        t.actor = actor_cls.options(
+                            max_concurrency=2).remote(
+                                t.trial_id, fn_blob, new_config, src_ckpt)
+                        if hasattr(scheduler, "on_exploit_applied"):
+                            scheduler.on_exploit_applied(t.trial_id)
+                        self._save_state(trials)
                 elif decision == STOP:
                     t.status = Trial.STOPPED
                     try:

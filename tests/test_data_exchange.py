@@ -1,0 +1,128 @@
+"""All-to-all Data ops: shuffle / sort / groupby / parquet (VERDICT r2
+item 5). Reference parity: python/ray/data/dataset.py:1374
+(random_shuffle), :2472 (sort), :2099 (groupby), arrow_block.py.
+"""
+
+import sys
+
+import cloudpickle
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_random_shuffle_preserves_multiset(ray_cluster):
+    ds = rd.range(1000, parallelism=8)
+    out = ds.random_shuffle(seed=7).take_all()
+    assert sorted(out) == list(range(1000))
+    assert out != list(range(1000))  # actually permuted
+
+
+def test_random_shuffle_deterministic_with_seed(ray_cluster):
+    a = rd.range(500, parallelism=4).random_shuffle(seed=3).take_all()
+    b = rd.range(500, parallelism=4).random_shuffle(seed=3).take_all()
+    assert a == b
+
+
+def test_sort_scalars_multi_block(ray_cluster):
+    rng = np.random.RandomState(0)
+    vals = [int(v) for v in rng.randint(0, 10_000, 2_000)]
+    out = rd.from_items(vals, parallelism=8).sort().take_all()
+    assert out == sorted(vals)
+
+
+def test_sort_by_column_descending(ray_cluster):
+    rows = [{"k": i % 17, "v": i} for i in range(400)]
+    out = rd.from_items(rows, parallelism=6).sort("k", descending=True) \
+            .take_all()
+    assert [r["k"] for r in out] == sorted((r["k"] for r in rows),
+                                           reverse=True)
+
+
+def test_sort_after_map(ray_cluster):
+    out = rd.range(100, parallelism=5).map(lambda x: 99 - x).sort().take_all()
+    assert out == list(range(100))
+
+
+def test_groupby_aggregate_matches_inmemory(ray_cluster):
+    rng = np.random.RandomState(1)
+    rows = [{"k": int(k), "v": float(v)}
+            for k, v in zip(rng.randint(0, 13, 1_500),
+                            rng.rand(1_500) * 10)]
+    out = rd.from_items(rows, parallelism=8).groupby("k").aggregate(
+        rd.Count(), rd.Sum("v"), rd.Mean("v"), rd.Min("v"), rd.Max("v"),
+    ).take_all()
+    by_k = {}
+    for r in rows:
+        by_k.setdefault(r["k"], []).append(r["v"])
+    assert len(out) == len(by_k)
+    for row in out:
+        vs = by_k[row["k"]]
+        assert row["count"] == len(vs)
+        np.testing.assert_allclose(row["sum(v)"], sum(vs))
+        np.testing.assert_allclose(row["mean(v)"], sum(vs) / len(vs))
+        assert row["min(v)"] == min(vs) and row["max(v)"] == max(vs)
+
+
+def test_groupby_map_groups(ray_cluster):
+    rows = [{"k": i % 3, "v": i} for i in range(30)]
+    out = rd.from_items(rows, parallelism=4).groupby("k").map_groups(
+        lambda rs: {"k": rs[0]["k"], "n": len(rs)}).take_all()
+    assert sorted((r["k"], r["n"]) for r in out) == [(0, 10), (1, 10), (2, 10)]
+
+
+def test_parquet_round_trip(ray_cluster, tmp_path):
+    rows = [{"a": i, "b": float(i) / 3, "s": f"row{i}"} for i in range(200)]
+    paths = rd.from_items(rows, parallelism=4).write_parquet(
+        str(tmp_path / "pq"))
+    assert len(paths) == 4
+    back = rd.read_parquet(str(tmp_path / "pq")).take_all()
+    assert sorted(back, key=lambda r: r["a"]) == rows
+    # column pruning
+    only_a = rd.read_parquet(str(tmp_path / "pq"), columns=["a"]).take_all()
+    assert set(only_a[0].keys()) == {"a"}
+
+
+def test_pyarrow_batch_format(ray_cluster):
+    import pyarrow as pa
+
+    rows = [{"x": i} for i in range(100)]
+
+    def double(table: "pa.Table") -> "pa.Table":
+        import pyarrow.compute as pc
+
+        return table.set_column(0, "x", pc.multiply(table["x"], 2))
+
+    out = rd.from_items(rows, parallelism=4).map_batches(
+        double, batch_format="pyarrow").take_all()
+    assert sorted(r["x"] for r in out) == [2 * i for i in range(100)]
+    batches = list(rd.from_items(rows, parallelism=2).iter_batches(
+        batch_size=40, batch_format="pyarrow"))
+    assert isinstance(batches[0], pa.Table)
+    assert sum(b.num_rows for b in batches) == 100
+
+
+def test_shuffled_train_ingestion(ray_cluster):
+    """Shuffle -> shard -> iter_batches: every row exactly once across
+    shards, shard contents differ from the unshuffled split (the Data ->
+    Train ingestion contract, reference: DataParallelTrainer datasets=)."""
+    ds = rd.range(512, parallelism=8).random_shuffle(seed=11)
+    shards = ds.split(4)
+    seen = []
+    for sh in shards:
+        for batch in sh.iter_batches(batch_size=32):
+            seen.extend(int(v) for v in batch)
+    assert sorted(seen) == list(range(512))
+    plain_shard0 = rd.range(512, parallelism=8).split(4)[0].take_all()
+    assert shards[0].take_all() != plain_shard0

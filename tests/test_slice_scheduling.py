@@ -1,0 +1,151 @@
+"""Multi-host TPU slice-bundle gang scheduling (VERDICT r2 item 1).
+
+Reference parity: bundle gang placement over pod slices
+(src/ray/raylet/scheduling/policy/bundle_scheduling_policy.h:82-106),
+slice identity from pod metadata (python/ray/_private/accelerators/
+tpu.py:19-44), and the shared topology env across a train gang
+(python/ray/train/_internal/backend_executor.py:306-322).
+
+Fake hosts: Cluster nodelets with asserted TPU:4 + slice labels — the
+reference's multi-node-on-one-box test strategy (SURVEY.md §4).
+"""
+
+import sys
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core import tpu as tpu_mod
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+
+def _slice_labels(slice_name, worker_id, pod_type="v4-16"):
+    return {
+        tpu_mod.SLICE_LABEL: slice_name,
+        tpu_mod.WORKER_ID_LABEL: str(worker_id),
+        tpu_mod.POD_TYPE_LABEL: pod_type,
+        tpu_mod.TOPOLOGY_LABEL: "2x2x2",
+    }
+
+
+@pytest.fixture(scope="module")
+def slice_cluster():
+    """Two fake slices x two fake hosts, TPU:4 each (a v4-16 pair)."""
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    nodes = {}
+    for sl in ("slice-a", "slice-b"):
+        for wid in (0, 1):
+            nl = c.add_node(num_cpus=4, num_tpus=4,
+                            labels=_slice_labels(sl, wid))
+            nodes[(sl, wid)] = nl.node_id.hex()
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c, nodes
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _labels_by_node_hex():
+    return {n["NodeID"]: n.get("Labels") or {} for n in ray_tpu.nodes()}
+
+
+def test_strict_pack_gang_one_slice_worker_order(slice_cluster):
+    """A 2x{TPU:4} STRICT_PACK gang = a slice bundle: both bundles on the
+    hosts of ONE slice, bundle i on TPU_WORKER_ID i."""
+    _, nodes = slice_cluster
+    pg = placement_group([{"TPU": 4.0}, {"TPU": 4.0}],
+                         strategy="STRICT_PACK")
+    assert pg.wait(30)
+    placed = pg._state()["nodes"]
+    labels = _labels_by_node_hex()
+    slices = {labels[nid][tpu_mod.SLICE_LABEL] for nid in placed}
+    assert len(slices) == 1, f"gang crossed slices: {slices}"
+    wids = [int(labels[nid][tpu_mod.WORKER_ID_LABEL]) for nid in placed]
+    assert wids == [0, 1], f"bundle->worker-id order wrong: {wids}"
+    remove_placement_group(pg)
+
+
+def test_spread_gang_prefers_distinct_slices(slice_cluster):
+    """SPREAD with TPU bundles puts one gang member per DCN domain."""
+    pg = placement_group([{"TPU": 2.0}, {"TPU": 2.0}], strategy="SPREAD")
+    assert pg.wait(30)
+    placed = pg._state()["nodes"]
+    labels = _labels_by_node_hex()
+    slices = {labels[nid][tpu_mod.SLICE_LABEL] for nid in placed}
+    assert len(slices) == 2, f"SPREAD stayed within one slice: {slices}"
+    remove_placement_group(pg)
+
+
+def test_slice_head_marker_resource(slice_cluster):
+    """Worker 0 of each slice asserts TPU-{pod_type}-head (reference:
+    accelerators/tpu.py marker resource) so one task targets each slice."""
+    total = ray_tpu.cluster_resources()
+    assert total.get("TPU-v4-16-head") == 2.0  # one per slice
+
+
+def test_strict_pack_single_host_still_packs(slice_cluster):
+    """A gang that fits one host must not be force-spread."""
+    pg = placement_group([{"TPU": 2.0}, {"TPU": 2.0}],
+                         strategy="STRICT_PACK")
+    assert pg.wait(30)
+    placed = pg._state()["nodes"]
+    assert len(set(placed)) == 1
+    remove_placement_group(pg)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: a JaxTrainer gang lands one worker per host of one slice
+# with the slice-derived libtpu topology env.
+# ---------------------------------------------------------------------------
+
+def _probe_loop(config):
+    import os
+
+    import ray_tpu as rt
+    from ray_tpu import train
+
+    ctx = train.get_context()
+    my_node = os.environ["RAY_TPU_NODE_ID"]
+    labels = {n["NodeID"]: n.get("Labels") or {} for n in rt.nodes()}[my_node]
+    # slice-derived worker id, not join order
+    assert os.environ["TPU_WORKER_ID"] == labels["ray.io/tpu-worker-id"], (
+        os.environ["TPU_WORKER_ID"], labels)
+    hostnames = os.environ["TPU_WORKER_HOSTNAMES"].split(",")
+    assert len(hostnames) == ctx.get_world_size()
+    assert os.environ["TPU_ACCELERATOR_TYPE"] == "v4-16"
+    assert os.environ["TPU_NAME"] == labels["ray.io/tpu-slice"]
+    assert ctx.get_local_world_size() == 1  # one worker per host
+    train.report({
+        "rank": ctx.get_world_rank(),
+        "tpu_worker_id": int(os.environ["TPU_WORKER_ID"]),
+        "node_rank": ctx.get_node_rank(),
+    })
+
+
+def test_trainer_gang_slice_topology(slice_cluster, tmp_path):
+    from ray_tpu.train import (
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+    )
+
+    trainer = JaxTrainer(
+        _probe_loop,
+        train_loop_config={},
+        scaling_config=ScalingConfig(
+            num_workers=2,
+            use_tpu=True,
+            resources_per_worker={"CPU": 1.0, "TPU": 4.0},
+            placement_strategy="STRICT_PACK",
+            num_cpu_devices_per_worker=1,
+        ),
+        run_config=RunConfig(name="slice_gang", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    # rank i landed on slice worker i (bundle->worker-id order)
+    assert result.metrics_history[0]["tpu_worker_id"] == \
+        result.metrics_history[0]["rank"]

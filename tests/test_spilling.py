@@ -1,0 +1,114 @@
+"""Object spilling, push transfer, pull admission (VERDICT r2 item 5/6).
+
+Reference parity: raylet/local_object_manager.h:41 (spill pinned
+primaries under pressure, restore on access),
+object_manager/push_manager.h:30 (proactive transfer toward consumers),
+pull_manager.h:52 (bounded pull admission).
+"""
+
+import os
+import sys
+
+import cloudpickle
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture
+def small_store():
+    """Driver with a deliberately tiny (32MB) local store."""
+    ray_tpu.init(num_cpus=4, store_capacity=32 * 1024 * 1024)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_put_beyond_capacity_spills_and_reads_back(small_store):
+    """Put 10 x 8MB (2.5x store capacity) with all refs held: earlier
+    primaries spill to disk; every object reads back intact."""
+    refs, arrays = [], []
+    for i in range(10):
+        a = np.full(8 << 20, i, np.uint8)
+        arrays.append(a)
+        refs.append(ray_tpu.put(a))
+    rt = ray_tpu.core.api._global_runtime()
+    spilled = [b for b, st in rt._owned.items() if st.spilled_path]
+    assert spilled, "no object was spilled despite store pressure"
+    for i, r in enumerate(refs):
+        out = ray_tpu.get(r)
+        assert out[0] == i and out[-1] == i and len(out) == 8 << 20
+
+
+def test_spilled_object_usable_as_task_arg(small_store):
+    """A spilled primary is restored when a worker borrows it."""
+    refs = [ray_tpu.put(np.full(8 << 20, i, np.uint8)) for i in range(8)]
+    rt = ray_tpu.core.api._global_runtime()
+    spilled = [b for b, st in rt._owned.items() if st.spilled_path]
+    assert spilled
+
+    @ray_tpu.remote(num_cpus=1)
+    def head_byte(a):
+        return int(a[0])
+
+    vals = ray_tpu.get([head_byte.remote(r) for r in refs], timeout=120)
+    assert vals == list(range(8))
+
+
+def test_spill_files_cleaned_on_free(small_store):
+    refs = [ray_tpu.put(np.full(8 << 20, i, np.uint8)) for i in range(8)]
+    rt = ray_tpu.core.api._global_runtime()
+    paths = [st.spilled_path for st in rt._owned.values() if st.spilled_path]
+    assert paths and all(os.path.exists(p) for p in paths)
+    del refs
+    import gc
+
+    gc.collect()
+    assert all(not os.path.exists(p) for p in paths)
+
+
+def test_push_transfer_prefetches_arg():
+    """Submitting a task whose big arg lives on node A while the task is
+    pinned to node B triggers an owner-directed push: B's store holds the
+    bytes without the worker having to pull them at exec time."""
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    nl_b = c.add_node(num_cpus=2, resources={"b": 2.0})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    try:
+        arr = np.arange(1 << 20, dtype=np.uint8)
+        ref = ray_tpu.put(arr)  # primary on the driver's node (A)
+        oid = ref.id.binary()
+
+        @ray_tpu.remote(resources={"b": 1.0}, num_cpus=0.1)
+        def consume(a):
+            return int(a[-1])
+
+        assert ray_tpu.get(consume.remote(ref), timeout=60) == arr[-1]
+        # the push landed a secondary copy in B's store
+        assert nl_b.store.contains(oid)
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def test_reader_crash_mid_get_does_not_wedge_store(small_store):
+    """Kill a worker while it holds a zero-copy read view; the store must
+    keep serving and the object must remain readable (weak item r2#8:
+    crashed-reader refcount)."""
+    big = ray_tpu.put(np.zeros(4 << 20, np.uint8))
+
+    @ray_tpu.remote(num_cpus=1)
+    def crash_while_reading(a):
+        # `a` aliases the store; die without releasing the view
+        os._exit(1)
+
+    with pytest.raises(ray_tpu.core.exceptions.RayTpuError):
+        ray_tpu.get(crash_while_reading.remote(big), timeout=60)
+    # store still serves reads and accepts new objects
+    assert ray_tpu.get(big)[0] == 0
+    for i in range(8):  # churn past capacity: eviction/spill still works
+        ray_tpu.get(ray_tpu.put(np.full(4 << 20, i, np.uint8)))
