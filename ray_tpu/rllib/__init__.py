@@ -1,30 +1,61 @@
 """ray_tpu.rllib — reinforcement learning on the actor runtime.
 
 Reference parity: rllib/ new API stack — EnvRunner actors sampling
-gymnasium vector envs (env/single_agent_env_runner.py:64), a Learner
-whose update is a jitted SPMD program over a jax mesh
-(core/learner/learner.py:109, torch DDP wrap replaced by GSPMD), and
-Algorithm drivers starting with PPO (algorithms/ppo/ppo.py:389).
+gymnasium vector envs (env/single_agent_env_runner.py:64), connector
+pipelines (connectors/connector_v2.py:31), a catalog choosing conv/MLP
+encoders from the obs space (core/models/catalog.py:33), a Learner whose
+update is a jitted SPMD program over a jax mesh (core/learner/
+learner.py:109, torch DDP wrap replaced by GSPMD), prioritized replay
+(execution/segment_tree.py), hierarchical metrics
+(utils/metrics/metrics_logger.py), and five algorithm families: PPO,
+APPO, IMPALA, DQN (+PER), SAC.
 """
 
+from ray_tpu.rllib.appo import APPO, APPOConfig
+from ray_tpu.rllib.catalog import Catalog
+from ray_tpu.rllib.connectors import (
+    ConnectorPipeline,
+    ConnectorV2,
+    FlattenObs,
+    FrameStack,
+    GeneralAdvantageEstimation,
+    NormalizeImage,
+)
 from ray_tpu.rllib.dqn import DQN, DQNConfig, ReplayBuffer
-from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace
 from ray_tpu.rllib.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace
 from ray_tpu.rllib.learner import PPOLearner, PPOLearnerConfig, compute_gae
+from ray_tpu.rllib.metrics import MetricsLogger
 from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.replay import PrioritizedReplayBuffer, SumTree
+from ray_tpu.rllib.sac import SAC, SACConfig
 
 __all__ = [
+    "APPO",
+    "APPOConfig",
+    "Catalog",
+    "ConnectorPipeline",
+    "ConnectorV2",
     "DQN",
     "DQNConfig",
+    "EnvRunnerGroup",
+    "FlattenObs",
+    "FrameStack",
+    "GeneralAdvantageEstimation",
     "IMPALA",
     "IMPALAConfig",
-    "vtrace",
-    "EnvRunnerGroup",
+    "MetricsLogger",
+    "NormalizeImage",
     "PPO",
     "PPOConfig",
     "PPOLearner",
     "PPOLearnerConfig",
+    "PrioritizedReplayBuffer",
     "ReplayBuffer",
+    "SAC",
+    "SACConfig",
     "SingleAgentEnvRunner",
+    "SumTree",
     "compute_gae",
+    "vtrace",
 ]

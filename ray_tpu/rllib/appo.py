@@ -1,0 +1,88 @@
+"""APPO — asynchronous PPO (IMPALA architecture + clipped surrogate).
+
+Reference parity: rllib/algorithms/appo/appo.py (APPOConfig: IMPALA's
+async sampling/learner pipeline with the PPO clipped-ratio loss,
+optional KL penalty against a periodically-updated TARGET network —
+appo.py:36 docstring, target_network_update_freq, use_kl_loss). Built on
+ray_tpu's IMPALA driver: same env-runner/queue/learner-thread plumbing,
+the jitted update swapped for the APPO loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import models
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
+
+
+@dataclasses.dataclass
+class APPOConfig(IMPALAConfig):
+    clip_param: float = 0.2
+    use_kl_loss: bool = False
+    kl_coeff: float = 0.2
+    target_update_freq: int = 20  # learner steps between target syncs
+    lr: float = 3e-4
+
+    def build(self) -> "APPO":
+        return APPO(self)
+
+
+class APPO(IMPALA):
+    def __init__(self, config: APPOConfig):
+        super().__init__(config)
+        cfg = config
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self._appo_updates = 0
+
+        def loss_fn(params, target_params, batch):
+            logits, value = models.forward(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=1)[:, 0]
+            # clipped surrogate against the BEHAVIOR policy's logp (the
+            # sample is off-policy; V-trace already corrected the targets)
+            ratio = jnp.exp(logp - batch["logp_old"])
+            adv = batch["advantages"]
+            surr = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - cfg.clip_param,
+                         1 + cfg.clip_param) * adv)
+            m = batch["mask"]  # autoreset steps carry no loss
+            denom = jnp.maximum(jnp.sum(m), 1.0)
+            pg = -jnp.sum(m * surr) / denom
+            vf = jnp.sum(m * (value - batch["vs"]) ** 2) / denom
+            ent = -jnp.sum(m * jnp.sum(
+                jnp.exp(logp_all) * logp_all, axis=-1)) / denom
+            total = pg + cfg.vf_loss_coeff * vf - cfg.entropy_coeff * ent
+            if cfg.use_kl_loss:
+                t_logits, _ = models.forward(target_params, batch["obs"])
+                t_logp_all = jax.nn.log_softmax(t_logits)
+                kl = jnp.sum(m * jnp.sum(
+                    jnp.exp(t_logp_all) * (t_logp_all - logp_all),
+                    axis=-1)) / denom
+                total = total + cfg.kl_coeff * kl
+            return total
+
+        def step(params, opt_state, target_params, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, target_params, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._appo_step = jax.jit(step)
+
+        def update(params, opt_state, batch):
+            new_params, new_opt, loss = self._appo_step(
+                params, opt_state, self.target_params, batch)
+            self._appo_updates += 1
+            if self._appo_updates % cfg.target_update_freq == 0:
+                self.target_params = jax.tree.map(jnp.copy, new_params)
+            return new_params, new_opt, loss
+
+        self._update = update  # the learner thread calls this

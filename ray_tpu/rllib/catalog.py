@@ -1,0 +1,129 @@
+"""Catalog — obs-space-driven encoder construction.
+
+Reference parity: rllib/core/models/catalog.py:33 (Catalog decides the
+encoder family from the observation space: CNN for image spaces, MLP for
+vectors) and the default Atari conv stack from models/utils.py. Here the
+encoder is a pure-functional jax (init, apply) pair: conv layers run as
+`lax.conv_general_dilated` in NHWC — channels-last keeps the channel
+dim on the TPU lane axis so XLA tiles the implicit GEMMs onto the MXU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ConvLayer:
+    """One conv layer; `stride` is STATIC pytree metadata (it shapes the
+    compiled program, it is not a trainable leaf)."""
+
+    w: jax.Array
+    b: jax.Array
+    stride: int = dataclasses.field(metadata={"static": True})
+
+# (out_channels, kernel, stride)
+ATARI_FILTERS = ((32, 8, 4), (64, 4, 2), (64, 3, 1))
+SMALL_FILTERS = ((16, 3, 2), (32, 3, 2))
+
+
+def conv_filters_for(obs_shape) -> tuple:
+    """Default filter spec by input resolution (reference:
+    catalog._get_encoder_config image branch)."""
+    h = obs_shape[0]
+    return ATARI_FILTERS if h >= 64 else SMALL_FILTERS
+
+
+def init_conv_encoder(key, obs_shape, filters=None, out_dim: int = 256):
+    """Params for conv stack + dense projection. obs NHWC float32."""
+    filters = filters or conv_filters_for(obs_shape)
+    h, w, c = obs_shape
+    params = {"conv": [], "proj": None}
+    for (oc, k, s) in filters:
+        key, sub = jax.random.split(key)
+        fan_in = k * k * c
+        params["conv"].append(ConvLayer(
+            w=jax.random.normal(sub, (k, k, c, oc)) * np.sqrt(2.0 / fan_in),
+            b=jnp.zeros((oc,)),
+            stride=int(s),
+        ))
+        h = -(-h // s)
+        w = -(-w // s)
+        c = oc
+    flat = h * w * c
+    key, sub = jax.random.split(key)
+    params["proj"] = {
+        "w": jax.random.normal(sub, (flat, out_dim)) * np.sqrt(2.0 / flat),
+        "b": jnp.zeros((out_dim,)),
+    }
+    return params, out_dim
+
+
+def apply_conv_encoder(params, obs):
+    """obs (B, H, W, C) float32 -> features (B, out_dim)."""
+    x = obs
+    for lyr in params["conv"]:
+        x = jax.lax.conv_general_dilated(
+            x, lyr.w, window_strides=(lyr.stride, lyr.stride),
+            padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        x = jax.nn.relu(x + lyr.b)
+    x = x.reshape(x.shape[0], -1)
+    p = params["proj"]
+    return jax.nn.relu(x @ p["w"] + p["b"])
+
+
+def init_mlp_encoder(key, in_dim: int, hidden=(64, 64)):
+    sizes = (in_dim, *hidden)
+    layers = []
+    for fan_in, fan_out in zip(sizes[:-1], sizes[1:]):
+        key, sub = jax.random.split(key)
+        layers.append({
+            "w": jax.random.normal(sub, (fan_in, fan_out)) *
+            np.sqrt(2.0 / fan_in),
+            "b": jnp.zeros((fan_out,)),
+        })
+    return {"mlp": layers}, (hidden[-1] if hidden else in_dim)
+
+
+def apply_mlp_encoder(params, obs):
+    x = obs
+    for lyr in params["mlp"]:
+        x = jnp.tanh(x @ lyr["w"] + lyr["b"])
+    return x
+
+
+def init_head(key, in_dim: int, out_dim: int, scale: float = 0.01):
+    return {"w": jax.random.normal(key, (in_dim, out_dim)) * scale,
+            "b": jnp.zeros((out_dim,))}
+
+
+def apply_head(params, x):
+    return x @ params["w"] + params["b"]
+
+
+class Catalog:
+    """Encoder/head factory keyed on the observation shape (reference:
+    Catalog.build_encoder, core/models/catalog.py:33)."""
+
+    @staticmethod
+    def is_image(obs_shape) -> bool:
+        return len(obs_shape) == 3
+
+    @staticmethod
+    def build_encoder(key, obs_shape, model_config=None):
+        """Returns (params, apply_fn, feature_dim)."""
+        mc = model_config or {}
+        if Catalog.is_image(obs_shape):
+            params, dim = init_conv_encoder(
+                key, obs_shape, filters=mc.get("conv_filters"),
+                out_dim=mc.get("conv_out", 256))
+            return params, apply_conv_encoder, dim
+        in_dim = int(np.prod(obs_shape))
+        params, dim = init_mlp_encoder(key, in_dim,
+                                       hidden=mc.get("hidden", (64, 64)))
+        return params, apply_mlp_encoder, dim

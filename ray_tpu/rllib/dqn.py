@@ -45,6 +45,9 @@ class ReplayBuffer:
         self.pos = int((self.pos + n) % self.capacity)
         self.size = int(min(self.size + n, self.capacity))
 
+    def __len__(self):
+        return self.size
+
     def sample(self, batch_size: int, rng: np.random.RandomState) -> dict:
         idx = rng.randint(0, self.size, batch_size)
         return {
@@ -74,6 +77,11 @@ class DQNConfig:
     epsilon_final: float = 0.05
     epsilon_decay_steps: int = 10_000
     hidden: tuple = (64, 64)
+    # proportional prioritized replay (reference: PER via segment trees,
+    # rllib/execution/segment_tree.py + prioritized_episode_buffer)
+    prioritized_replay: bool = False
+    per_alpha: float = 0.6
+    per_beta: float = 0.4
     seed: int = 0
 
     def environment(self, env: str) -> "DQNConfig":
@@ -120,7 +128,14 @@ class DQN:
         self.target_params = jax.tree.map(jnp.copy, self.params)
         self.tx = optax.adam(config.lr)
         self.opt_state = self.tx.init(self.params)
-        self.buffer = ReplayBuffer(config.buffer_capacity, self.obs_dim)
+        if config.prioritized_replay:
+            from ray_tpu.rllib.replay import PrioritizedReplayBuffer
+
+            self.buffer = PrioritizedReplayBuffer(
+                config.buffer_capacity, alpha=config.per_alpha,
+                beta=config.per_beta, seed=config.seed)
+        else:
+            self.buffer = ReplayBuffer(config.buffer_capacity, self.obs_dim)
         self._rng = np.random.RandomState(config.seed)
         self._env_steps = 0
         self._updates = 0
@@ -154,15 +169,18 @@ class DQN:
             target = batch["rewards"] + cfg.gamma * (1 - batch["dones"]) \
                 * q_next
             td = q_taken - jax.lax.stop_gradient(target)
-            return jnp.mean(jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
-                                      jnp.abs(td) - 0.5))  # Huber
+            huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
+                              jnp.abs(td) - 0.5)
+            # importance weights correct the PER sampling bias (uniform
+            # replay passes ones)
+            return jnp.mean(batch["weights"] * huber), td
 
         def update(params, opt_state, target_params, batch):
-            loss, grads = jax.value_and_grad(td_loss)(
+            (loss, td), grads = jax.value_and_grad(td_loss, has_aux=True)(
                 params, target_params, batch)
             updates, opt_state = self.tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return params, opt_state, loss
+            return params, opt_state, loss, td
 
         self._update = jax.jit(update, donate_argnums=(0, 1))
         self._sync_runner_weights()
@@ -196,23 +214,45 @@ class DQN:
             # transitions (o_t, a_t, r_t, o_{t+1}): the final step of a
             # fragment has no in-fragment successor — drop it (1/T of
             # data) rather than fabricate one
-            obs = s["obs"][:-1].reshape(-1, s["obs"].shape[-1])
-            nxt = s["obs"][1:].reshape(-1, s["obs"].shape[-1])
-            self.buffer.add_batch(obs, s["actions"][:-1].reshape(-1),
-                                  s["rewards"][:-1].reshape(-1), nxt,
-                                  s["dones"][:-1].reshape(-1))
+            # drop autoreset steps: their action was ignored by the env
+            # and their successor belongs to the next episode (done-step
+            # pairs stay — done=1 already masks their bootstrap)
+            rm = s["reset_mask"]
+            valid = (~rm[:-1]).reshape(-1)
+            obs = s["obs"][:-1].reshape(-1, s["obs"].shape[-1])[valid]
+            nxt = s["obs"][1:].reshape(-1, s["obs"].shape[-1])[valid]
+            acts = s["actions"][:-1].reshape(-1)[valid]
+            rews = s["rewards"][:-1].reshape(-1)[valid]
+            dns = s["dones"][:-1].reshape(-1)[valid]
+            if cfg.prioritized_replay:
+                self.buffer.add_batch({
+                    "obs": obs, "actions": acts, "rewards": rews,
+                    "next_obs": nxt, "dones": dns.astype(np.float32),
+                })
+            else:
+                self.buffer.add_batch(obs, acts, rews, nxt, dns)
             env_steps += s["env_steps"]
             if s["num_episodes"]:
                 ep_returns.append(s["episode_return_mean"])
         self._env_steps += env_steps
 
         losses = []
-        if self.buffer.size >= cfg.num_steps_sampled_before_learning:
+        if len(self.buffer) >= cfg.num_steps_sampled_before_learning:
             for _ in range(cfg.updates_per_iteration):
-                batch = self.buffer.sample(cfg.train_batch_size, self._rng)
+                if cfg.prioritized_replay:
+                    batch = self.buffer.sample(cfg.train_batch_size)
+                    idxs = batch.pop("idxs")
+                else:
+                    batch = self.buffer.sample(cfg.train_batch_size,
+                                               self._rng)
+                    batch["weights"] = np.ones(
+                        len(batch["actions"]), np.float32)
+                    idxs = None
                 batch = {k: jnp.asarray(v) for k, v in batch.items()}
-                self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state, loss, td = self._update(
                     self.params, self.opt_state, self.target_params, batch)
+                if idxs is not None:
+                    self.buffer.update_priorities(idxs, np.asarray(td))
                 losses.append(float(loss))
                 self._updates += 1
                 if self._updates % cfg.target_update_freq == 0:
@@ -229,7 +269,7 @@ class DQN:
             "epsilon": self._epsilon(),
             "learner/td_loss": float(np.mean(losses)) if losses
             else float("nan"),
-            "buffer_size": self.buffer.size,
+            "buffer_size": len(self.buffer),
         }
 
     def stop(self):

@@ -17,27 +17,54 @@ class SingleAgentEnvRunner:
 
     def __init__(self, env: str = "CartPole-v1", num_envs: int = 1,
                  rollout_fragment_length: int = 200, seed: int = 0,
-                 hidden=(64, 64)):
+                 hidden=(64, 64), framestack: int = 1,
+                 model_config: dict | None = None):
         import gymnasium as gym
         import jax
 
+        from ray_tpu.rllib import envs as _envs  # registers PixelCatch etc.
+
+        _envs.register_envs()
         self._jax = jax
         self.envs = gym.make_vec(env, num_envs=num_envs)
         self.num_envs = num_envs
         self.T = rollout_fragment_length
-        self.obs_dim = int(np.prod(self.envs.single_observation_space.shape))
+        raw_shape = tuple(self.envs.single_observation_space.shape)
         self.n_actions = int(self.envs.single_action_space.n)
         from ray_tpu.rllib import models
+        from ray_tpu.rllib.connectors import default_env_to_module
+
+        # env→module connector pipeline (reference: connector_v2.py:31);
+        # image obs get normalize(+framestack), vectors get flatten —
+        # the module sees the PROCESSED shape everywhere (buffers, nets)
+        self.pipeline = default_env_to_module(raw_shape, framestack)
+        self.pipeline.reset(num_envs)
+        self.obs_shape = self.pipeline.output_shape(raw_shape)
+        self.obs_dim = int(np.prod(self.obs_shape))  # legacy vector algos
+        self._image = len(self.obs_shape) == 3
 
         self._models = models
-        self.params = models.init_mlp_policy(
-            jax.random.PRNGKey(seed), self.obs_dim, self.n_actions, hidden)
+        mc = dict(model_config or {})
+        mc.setdefault("hidden", tuple(hidden))
+        if self._image:
+            self.params = models.init_actor_critic(
+                jax.random.PRNGKey(seed), self.obs_shape, self.n_actions,
+                mc)
+        else:
+            self.params = models.init_mlp_policy(
+                jax.random.PRNGKey(seed), self.obs_dim, self.n_actions,
+                mc["hidden"])
         self._sample_fn = jax.jit(models.sample_actions)
         self._key = jax.random.PRNGKey(seed + 1)
-        self.obs, _ = self.envs.reset(seed=seed)
+        raw_obs, _ = self.envs.reset(seed=seed)
+        self.obs = self.pipeline(raw_obs)
         self._ep_returns = np.zeros(num_envs)
         self._completed_returns: list[float] = []
         self._env_steps_total = 0
+        # gymnasium NEXT-STEP autoreset: the obs returned on the step
+        # AFTER done is a reset frame (and that step's action is
+        # ignored). Carried across fragments for reset_mask correctness.
+        self._last_done = np.zeros(num_envs, np.bool_)
 
     # -- weights ---------------------------------------------------------
 
@@ -58,12 +85,16 @@ class SingleAgentEnvRunner:
         stats for completed episodes."""
         jax = self._jax
         T, N = self.T, self.num_envs
-        obs_buf = np.empty((T, N, self.obs_dim), np.float32)
+        obs_buf = np.empty((T, N, *self.obs_shape), np.float32)
         act_buf = np.empty((T, N), np.int64)
         logp_buf = np.empty((T, N), np.float32)
         val_buf = np.empty((T, N), np.float32)
         rew_buf = np.empty((T, N), np.float32)
         done_buf = np.empty((T, N), np.bool_)
+        # reset_mask[t]: the obs at step t is an autoreset frame — the
+        # env IGNORED that step's action (next-step autoreset), so the
+        # transition is not real experience and learners must drop it
+        reset_buf = np.empty((T, N), np.bool_)
 
         obs = self.obs
         for t in range(T):
@@ -71,7 +102,7 @@ class SingleAgentEnvRunner:
             action, logp, value = self._sample_fn(
                 self.params, obs.astype(np.float32), k)
             action = np.asarray(action)
-            next_obs, reward, term, trunc, _ = self.envs.step(action)
+            raw_next, reward, term, trunc, _ = self.envs.step(action)
             done = np.logical_or(term, trunc)
             obs_buf[t] = obs
             act_buf[t] = action
@@ -79,11 +110,17 @@ class SingleAgentEnvRunner:
             val_buf[t] = np.asarray(value)
             rew_buf[t] = reward
             done_buf[t] = done
+            reset_buf[t] = self._last_done
             self._ep_returns += reward
             for i in np.nonzero(done)[0]:
                 self._completed_returns.append(float(self._ep_returns[i]))
                 self._ep_returns[i] = 0.0
-            obs = next_obs
+            # next-step autoreset timeline: the done step returns the
+            # FINAL frame (shift it in — it belongs to the old episode);
+            # the RESET frame arrives one iteration later, i.e. raw_next
+            # is a fresh frame exactly where the PREVIOUS step was done.
+            obs = self.pipeline(raw_next, dones=self._last_done)
+            self._last_done = done
         self.obs = obs
         self._env_steps_total += T * N
         # bootstrap value for the final observation of each env
@@ -98,6 +135,7 @@ class SingleAgentEnvRunner:
             "values": val_buf,
             "rewards": rew_buf,
             "dones": done_buf,
+            "reset_mask": reset_buf,
             "last_values": np.asarray(last_val),
             "episode_return_mean": float(np.mean(completed)) if completed
             else float("nan"),

@@ -1,0 +1,76 @@
+"""Synthetic pixel environments (Atari-class capability without ALE).
+
+Reference parity: the role of ALE Atari envs in
+rllib/benchmarks/ppo/benchmark_atari_ppo.py and the tuned_examples pixel
+configs — a conv-input env that requires spatial feature extraction to
+solve. ALE is not in this image (zero egress), so PixelCatch is the
+MinAtar-style stand-in: a ball falls down a HxW grid; the agent moves a
+paddle left/stay/right and is rewarded for catching it. Purely
+observational from pixels — an MLP on flattened pixels can solve it too,
+but the conv path is what the PPO pixel tests exercise end-to-end.
+"""
+
+from __future__ import annotations
+
+import gymnasium as gym
+import numpy as np
+from gymnasium import spaces
+
+
+class PixelCatch(gym.Env):
+    """10x10x1 uint8 pixel grid; 3 actions (left/stay/right); +1 catch,
+    -1 miss; episode = `balls` balls."""
+
+    metadata = {"render_modes": []}
+
+    def __init__(self, size: int = 10, balls: int = 5):
+        self.size = size
+        self.balls = balls
+        self.observation_space = spaces.Box(0, 255, (size, size, 1),
+                                            np.uint8)
+        self.action_space = spaces.Discrete(3)
+        self._rng = np.random.default_rng(0)
+
+    def _obs(self) -> np.ndarray:
+        frame = np.zeros((self.size, self.size, 1), np.uint8)
+        frame[self.ball_y, self.ball_x, 0] = 255
+        frame[self.size - 1, self.paddle_x, 0] = 128
+        return frame
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._balls_left = self.balls
+        self._new_ball()
+        self.paddle_x = self.size // 2
+        return self._obs(), {}
+
+    def _new_ball(self):
+        self.ball_x = int(self._rng.integers(0, self.size))
+        self.ball_y = 0
+
+    def step(self, action):
+        self.paddle_x = int(np.clip(self.paddle_x + (int(action) - 1),
+                                    0, self.size - 1))
+        self.ball_y += 1
+        reward = 0.0
+        terminated = False
+        if self.ball_y >= self.size - 1:
+            reward = 1.0 if self.ball_x == self.paddle_x else -1.0
+            self._balls_left -= 1
+            if self._balls_left <= 0:
+                terminated = True
+            else:
+                self._new_ball()
+        return self._obs(), reward, terminated, False, {}
+
+
+def register_envs():
+    """Idempotent gym registration (call before gym.make in any
+    process; env runners do this automatically)."""
+    if "PixelCatch-v0" not in gym.registry:
+        gym.register(id="PixelCatch-v0",
+                     entry_point="ray_tpu.rllib.envs:PixelCatch")
+
+
+register_envs()

@@ -148,9 +148,12 @@ class IMPALA:
             logp_all = jax.nn.log_softmax(logits)
             logp = jnp.take_along_axis(
                 logp_all, batch["actions"][:, None], axis=1)[:, 0]
-            pg = -jnp.mean(logp * batch["advantages"])
-            vf = jnp.mean((value - batch["vs"]) ** 2)
-            ent = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            m = batch["mask"]
+            denom = jnp.maximum(jnp.sum(m), 1.0)
+            pg = -jnp.sum(m * logp * batch["advantages"]) / denom
+            vf = jnp.sum(m * (value - batch["vs"]) ** 2) / denom
+            ent = -jnp.sum(m * jnp.sum(
+                jnp.exp(logp_all) * logp_all, axis=-1)) / denom
             return pg + cfg.vf_loss_coeff * vf - cfg.entropy_coeff * ent
 
         def update(params, opt_state, batch):
@@ -200,11 +203,18 @@ class IMPALA:
         vs, adv = vtrace(s["logp"], target_logp, s["rewards"], s["values"],
                          s["dones"], s["last_values"], cfg.gamma)
         adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        # loss MASK (not row-drop) for autoreset steps: keeps the jitted
+        # update's shapes static — row dropping would recompile per
+        # unique valid-count
+        mask = (~s["reset_mask"].reshape(-1)).astype(np.float32)
         return {
             "obs": jnp.asarray(obs_flat),
             "actions": jnp.asarray(s["actions"].reshape(-1)),
             "vs": jnp.asarray(vs.reshape(-1)),
             "advantages": jnp.asarray(adv.reshape(-1)),
+            # behavior logp: APPO's clipped surrogate needs it
+            "logp_old": jnp.asarray(s["logp"].reshape(-1)),
+            "mask": jnp.asarray(mask),
         }
 
     def train(self) -> dict:

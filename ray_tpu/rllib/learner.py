@@ -59,18 +59,28 @@ class PPOLearner:
     minibatch SGD. Pass a mesh to shard the batch over its 'data' axis
     (single-chip and CPU run with a trivial mesh)."""
 
-    def __init__(self, obs_dim: int, n_actions: int,
+    def __init__(self, obs_dim, n_actions: int,
                  config: PPOLearnerConfig | None = None, mesh=None,
-                 seed: int = 0):
+                 seed: int = 0, model_config: dict | None = None):
         self.config = config or PPOLearnerConfig()
         self.mesh = mesh
         self.tx = optax.chain(
             optax.clip_by_global_norm(self.config.grad_clip),
             optax.adam(self.config.lr),
         )
-        self.params = models.init_mlp_policy(
-            jax.random.PRNGKey(seed), obs_dim, n_actions,
-            self.config.hidden)
+        # obs_dim: int (vector, legacy towers) or a 3-tuple image shape
+        # (catalog conv actor-critic — core/models/catalog.py:33)
+        mc = dict(model_config or {})
+        mc.setdefault("hidden", self.config.hidden)
+        if isinstance(obs_dim, tuple) and len(obs_dim) == 3:
+            self.params = models.init_actor_critic(
+                jax.random.PRNGKey(seed), obs_dim, n_actions, mc)
+        else:
+            # honor a model_config hidden override for vector spaces too
+            # (the runner builds the same shape; weights are then synced)
+            self.params = models.init_mlp_policy(
+                jax.random.PRNGKey(seed), int(obs_dim), n_actions,
+                tuple(mc["hidden"]))
         self.opt_state = self.tx.init(self.params)
         cfg = self.config
 

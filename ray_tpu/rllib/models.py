@@ -45,8 +45,36 @@ def _mlp(layers, x):
     return x
 
 
+def init_actor_critic(key, obs_shape, n_actions: int,
+                      model_config: dict | None = None) -> dict:
+    """Catalog-built actor-critic: a shared encoder (conv for image
+    spaces, MLP for vectors — reference: catalog.py:33 encoder choice +
+    the shared-trunk Atari default) with small policy/value heads."""
+    from ray_tpu.rllib.catalog import Catalog, init_head
+
+    ke, kp, kv = jax.random.split(key, 3)
+    enc_params, _, dim = Catalog.build_encoder(ke, tuple(obs_shape),
+                                               model_config)
+    return {
+        "encoder": enc_params,
+        "pi_head": init_head(kp, dim, n_actions),
+        "vf_head": init_head(kv, dim, 1, scale=1.0),
+    }
+
+
 def forward(params: dict, obs: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """obs (B, obs_dim) -> (logits (B, A), value (B,))."""
+    """obs (B, *obs_shape) -> (logits (B, A), value (B,)). Dispatches on
+    the param-tree structure: catalog actor-critic (shared encoder) or
+    the legacy separate MLP towers."""
+    if "encoder" in params:
+        from ray_tpu.rllib import catalog as C
+
+        enc = params["encoder"]
+        feats = (C.apply_conv_encoder(enc, obs) if "conv" in enc
+                 else C.apply_mlp_encoder(enc, obs))
+        logits = C.apply_head(params["pi_head"], feats)
+        value = C.apply_head(params["vf_head"], feats)[..., 0]
+        return logits, value
     logits = _mlp(params["pi"], obs)
     value = _mlp(params["vf"], obs)[..., 0]
     return logits, value

@@ -16,7 +16,7 @@ from typing import Any
 import numpy as np
 
 from ray_tpu.rllib.env_runner import EnvRunnerGroup
-from ray_tpu.rllib.learner import PPOLearner, PPOLearnerConfig, compute_gae
+from ray_tpu.rllib.learner import PPOLearner, PPOLearnerConfig
 
 
 @dataclasses.dataclass
@@ -37,6 +37,8 @@ class PPOConfig:
     num_sgd_iter: int = 6
     minibatch_size: int = 128
     hidden: tuple = (64, 64)
+    framestack: int = 1  # >1: FrameStack connector on image obs
+    model_config: dict | None = None  # catalog overrides (conv_filters..)
     seed: int = 0
     num_learners: int = 0  # >1: learner mesh of that many devices
     learner_mesh: Any = None  # or pass an explicit jax Mesh
@@ -109,16 +111,33 @@ class PPO:
             rollout_fragment_length=config.rollout_fragment_length,
             seed=config.seed,
             hidden=config.hidden,
+            framestack=config.framestack,
+            model_config=config.model_config,
         )
         # probe spaces locally (cheap, no env stepping)
         import gymnasium as gym
 
+        from ray_tpu.rllib import envs as _envs
+        from ray_tpu.rllib.connectors import (
+            GeneralAdvantageEstimation,
+            default_env_to_module,
+        )
+
+        _envs.register_envs()
         probe = gym.make(config.env)
-        obs_dim = int(np.prod(probe.observation_space.shape))
+        raw_shape = tuple(probe.observation_space.shape)
         n_actions = int(probe.action_space.n)
         probe.close()
+        proc_shape = default_env_to_module(
+            raw_shape, config.framestack).output_shape(raw_shape)
+        obs_spec = (proc_shape if len(proc_shape) == 3
+                    else int(np.prod(proc_shape)))
+        # learner connector pipeline (reference: GAE lives in the learner
+        # connectors, general_advantage_estimation.py)
+        self._learner_connector = GeneralAdvantageEstimation(
+            config.gamma, config.lambda_)
         self.learner = PPOLearner(
-            obs_dim, n_actions,
+            obs_spec, n_actions,
             PPOLearnerConfig(
                 lr=config.lr, clip_param=config.clip_param,
                 vf_loss_coeff=config.vf_loss_coeff,
@@ -126,8 +145,13 @@ class PPO:
                 num_sgd_iter=config.num_sgd_iter,
                 minibatch_size=config.minibatch_size,
                 hidden=config.hidden),
-            mesh=config._resolve_learner_mesh(), seed=config.seed)
+            mesh=config._resolve_learner_mesh(), seed=config.seed,
+            model_config=config.model_config)
         self.env_runner_group.sync_weights(self.learner.get_weights())
+        from ray_tpu.rllib.metrics import MetricsLogger
+
+        # hierarchical windowed metrics (reference: metrics_logger.py)
+        self.metrics = MetricsLogger()
         self._iteration = 0
         self._env_steps_total = 0
 
@@ -143,14 +167,16 @@ class PPO:
         obs, acts, logp, adv, targets = [], [], [], [], []
         ep_returns, n_eps, env_steps = [], 0, 0
         for s in samples:
-            a, tg = compute_gae(s["rewards"], s["values"], s["dones"],
-                                s["last_values"], self.config.gamma,
-                                self.config.lambda_)
-            obs.append(s["obs"].reshape(-1, s["obs"].shape[-1]))
-            acts.append(s["actions"].reshape(-1))
-            logp.append(s["logp"].reshape(-1))
-            adv.append(a.reshape(-1))
-            targets.append(tg.reshape(-1))
+            s = self._learner_connector(s)
+            a, tg = s["advantages"], s["value_targets"]
+            # drop autoreset steps: their action was ignored by the env
+            # (next-step autoreset), so they are not real experience
+            valid = ~s["reset_mask"].reshape(-1)
+            obs.append(s["obs"].reshape(-1, *s["obs"].shape[2:])[valid])
+            acts.append(s["actions"].reshape(-1)[valid])
+            logp.append(s["logp"].reshape(-1)[valid])
+            adv.append(a.reshape(-1)[valid])
+            targets.append(tg.reshape(-1)[valid])
             if s["num_episodes"]:
                 ep_returns.append(s["episode_return_mean"])
                 n_eps += s["num_episodes"]
@@ -170,6 +196,12 @@ class PPO:
         self._iteration += 1
         self._env_steps_total += env_steps
         dt = time.perf_counter() - t0
+        if ep_returns:
+            self.metrics.log_value(("env_runners", "episode_return_mean"),
+                                   float(np.mean(ep_returns)), window=20)
+        self.metrics.log_value(("env_runners", "num_env_steps_sampled"),
+                               env_steps, reduce="sum", window=None)
+        self.metrics.log_dict(learner_metrics, key="learner", window=20)
         return {
             "training_iteration": self._iteration,
             "episode_return_mean": float(np.mean(ep_returns))

@@ -1,0 +1,258 @@
+"""SAC — off-policy continuous control (squashed-Gaussian actor, twin Q).
+
+Reference parity: rllib/algorithms/sac (sac.py SACConfig, the torch
+learner's twin-Q + tanh-Gaussian policy + auto-tuned entropy
+temperature, default_sac_rl_module). Functional jax: one jitted update
+performs the critic, actor and temperature steps; target critics track
+by polyak averaging. Continuous action spaces (Box); replay is the
+uniform ring or the prioritized buffer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+def _mlp_init(key, sizes, out_scale=1.0):
+    layers = []
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, k = jax.random.split(key)
+        scale = np.sqrt(2.0 / a) if i < len(sizes) - 2 else out_scale
+        layers.append({"w": jax.random.normal(k, (a, b)) * scale,
+                       "b": jnp.zeros((b,))})
+    return layers
+
+
+def _mlp(layers, x):
+    for i, lyr in enumerate(layers):
+        x = x @ lyr["w"] + lyr["b"]
+        if i < len(layers) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def init_sac_params(key, obs_dim: int, act_dim: int, hidden=(256, 256)):
+    kp, k1, k2 = jax.random.split(key, 3)
+    return {
+        "pi": _mlp_init(kp, (obs_dim, *hidden, 2 * act_dim), 0.01),
+        "q1": _mlp_init(k1, (obs_dim + act_dim, *hidden, 1)),
+        "q2": _mlp_init(k2, (obs_dim + act_dim, *hidden, 1)),
+    }
+
+
+def sample_action(params, obs, key):
+    """Squashed Gaussian: a = tanh(mu + std*eps); returns (a, logp)."""
+    out = _mlp(params["pi"], obs)
+    mu, log_std = jnp.split(out, 2, axis=-1)
+    log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(key, mu.shape)
+    pre = mu + std * eps
+    a = jnp.tanh(pre)
+    logp = jnp.sum(
+        -0.5 * (eps ** 2 + 2 * log_std + np.log(2 * np.pi))
+        - jnp.log(1 - a ** 2 + 1e-6), axis=-1)
+    return a, logp
+
+
+def q_values(params, obs, act):
+    x = jnp.concatenate([obs, act], axis=-1)
+    return _mlp(params["q1"], x)[..., 0], _mlp(params["q2"], x)[..., 0]
+
+
+@dataclasses.dataclass
+class SACConfig:
+    env: str = "Pendulum-v1"
+    num_envs: int = 8
+    rollout_fragment_length: int = 8
+    gamma: float = 0.99
+    lr: float = 3e-4
+    tau: float = 0.005  # polyak
+    buffer_capacity: int = 100_000
+    train_batch_size: int = 256
+    num_steps_sampled_before_learning: int = 1500
+    updates_per_iteration: int = 16
+    hidden: tuple = (256, 256)
+    initial_alpha: float = 1.0
+    target_entropy: float | None = None  # default: -act_dim
+    seed: int = 0
+
+    def environment(self, env: str) -> "SACConfig":
+        self.env = env
+        return self
+
+    def training(self, **kw) -> "SACConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC:
+    def __init__(self, config: SACConfig):
+        import gymnasium as gym
+
+        self.config = config
+        cfg = config
+        self.envs = gym.make_vec(cfg.env, num_envs=cfg.num_envs)
+        space = self.envs.single_action_space
+        self.obs_dim = int(np.prod(self.envs.single_observation_space.shape))
+        self.act_dim = int(np.prod(space.shape))
+        self._act_low = np.asarray(space.low, np.float32)
+        self._act_high = np.asarray(space.high, np.float32)
+        target_entropy = (cfg.target_entropy if cfg.target_entropy is not None
+                          else -float(self.act_dim))
+
+        key = jax.random.PRNGKey(cfg.seed)
+        self.params = init_sac_params(key, self.obs_dim, self.act_dim,
+                                      cfg.hidden)
+        self.target_q = {"q1": jax.tree.map(jnp.copy, self.params["q1"]),
+                         "q2": jax.tree.map(jnp.copy, self.params["q2"])}
+        self.log_alpha = jnp.asarray(np.log(cfg.initial_alpha), jnp.float32)
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.alpha_tx = optax.adam(cfg.lr)
+        self.alpha_opt = self.alpha_tx.init(self.log_alpha)
+
+        from ray_tpu.rllib.replay import PrioritizedReplayBuffer
+
+        self.buffer = PrioritizedReplayBuffer(cfg.buffer_capacity,
+                                              alpha=0.0, seed=cfg.seed)
+
+        def critic_loss(params, target_q, log_alpha, batch, key):
+            next_a, next_logp = sample_action(params, batch["next_obs"], key)
+            tq1 = _mlp(target_q["q1"],
+                       jnp.concatenate([batch["next_obs"], next_a], -1))[..., 0]
+            tq2 = _mlp(target_q["q2"],
+                       jnp.concatenate([batch["next_obs"], next_a], -1))[..., 0]
+            alpha = jnp.exp(log_alpha)
+            target = batch["rewards"] + cfg.gamma * (1 - batch["dones"]) * (
+                jnp.minimum(tq1, tq2) - alpha * next_logp)
+            q1, q2 = q_values(params, batch["obs"], batch["actions"])
+            target = jax.lax.stop_gradient(target)
+            return jnp.mean((q1 - target) ** 2 + (q2 - target) ** 2)
+
+        def actor_loss(params, log_alpha, batch, key):
+            a, logp = sample_action(params, batch["obs"], key)
+            q1, q2 = q_values(params, batch["obs"], a)
+            alpha = jax.lax.stop_gradient(jnp.exp(log_alpha))
+            return jnp.mean(alpha * logp - jnp.minimum(q1, q2)), logp
+
+        def update(params, opt_state, target_q, log_alpha, alpha_opt,
+                   batch, key):
+            kc, ka = jax.random.split(key)
+            c_loss, c_grads = jax.value_and_grad(critic_loss)(
+                params, target_q, log_alpha, batch, kc)
+            (a_loss, logp), a_grads = jax.value_and_grad(
+                actor_loss, has_aux=True)(params, log_alpha, batch, ka)
+            # actor grads touch only pi; critic grads touch only q1/q2 —
+            # merge per-subtree so each step is its textbook update
+            grads = {"pi": a_grads["pi"], "q1": c_grads["q1"],
+                     "q2": c_grads["q2"]}
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            # temperature: push entropy toward the target
+            al_grad = jax.grad(
+                lambda la: -jnp.mean(
+                    la * jax.lax.stop_gradient(logp + target_entropy))
+            )(log_alpha)
+            al_up, alpha_opt = self.alpha_tx.update(al_grad, alpha_opt)
+            log_alpha = optax.apply_updates(log_alpha, al_up)
+            target_q = jax.tree.map(
+                lambda t, o: (1 - cfg.tau) * t + cfg.tau * o,
+                target_q, {"q1": params["q1"], "q2": params["q2"]})
+            return (params, opt_state, target_q, log_alpha, alpha_opt,
+                    c_loss, a_loss)
+
+        self._update = jax.jit(update)
+        self._sample_fn = jax.jit(sample_action)
+        self._key = jax.random.PRNGKey(cfg.seed + 1)
+        self.obs, _ = self.envs.reset(seed=cfg.seed)
+        # next-step autoreset: the step after done has an ignored action
+        # and bridges two episodes — never store it (it would poison the
+        # replay buffer with fabricated transitions)
+        self._prev_done = np.zeros(cfg.num_envs, np.bool_)
+        self._ep_returns = np.zeros(cfg.num_envs)
+        self._completed: list[float] = []
+        self._env_steps = 0
+        self._iteration = 0
+
+    def _scale(self, a: np.ndarray) -> np.ndarray:
+        return self._act_low + (a + 1.0) * 0.5 * (self._act_high -
+                                                  self._act_low)
+
+    def train(self) -> dict:
+        cfg = self.config
+        t0 = time.perf_counter()
+        for _ in range(cfg.rollout_fragment_length):
+            self._key, k = jax.random.split(self._key)
+            a, _ = self._sample_fn(self.params,
+                                   self.obs.astype(np.float32), k)
+            a = np.asarray(a)
+            nxt, rew, term, trunc, _ = self.envs.step(self._scale(a))
+            done = np.logical_or(term, trunc)
+            valid = ~self._prev_done
+            if valid.any():
+                self.buffer.add_batch({
+                    "obs": self.obs[valid].astype(np.float32),
+                    "actions": a[valid],
+                    "rewards": np.asarray(rew, np.float32)[valid],
+                    "next_obs": nxt[valid].astype(np.float32),
+                    # truncation bootstraps
+                    "dones": term[valid].astype(np.float32),
+                })
+            self._prev_done = done
+            self._ep_returns += rew
+            for i in np.nonzero(done)[0]:
+                self._completed.append(float(self._ep_returns[i]))
+                self._ep_returns[i] = 0.0
+            self.obs = nxt
+            self._env_steps += cfg.num_envs
+
+        c_losses, a_losses = [], []
+        if len(self.buffer) >= cfg.num_steps_sampled_before_learning:
+            for _ in range(cfg.updates_per_iteration):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                batch.pop("idxs")
+                batch.pop("weights")
+                batch = {k: jnp.asarray(v) for k, v in batch.items()}
+                self._key, k = jax.random.split(self._key)
+                (self.params, self.opt_state, self.target_q,
+                 self.log_alpha, self.alpha_opt, cl, al) = self._update(
+                    self.params, self.opt_state, self.target_q,
+                    self.log_alpha, self.alpha_opt, batch, k)
+                c_losses.append(float(cl))
+                a_losses.append(float(al))
+
+        self._iteration += 1
+        window = self._completed[-100:]
+        self._completed = window
+        dt = time.perf_counter() - t0
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": float(np.mean(window)) if window
+            else float("nan"),
+            "num_env_steps_sampled_lifetime": self._env_steps,
+            "env_steps_per_sec": cfg.rollout_fragment_length *
+            cfg.num_envs / dt,
+            "alpha": float(np.exp(self.log_alpha)),
+            "learner/critic_loss": float(np.mean(c_losses)) if c_losses
+            else float("nan"),
+            "learner/actor_loss": float(np.mean(a_losses)) if a_losses
+            else float("nan"),
+        }
+
+    def stop(self):
+        self.envs.close()

@@ -1,0 +1,247 @@
+"""Atari-class RLlib stack: catalog/conv, connectors, pixel PPO, PER,
+APPO, SAC, metrics (VERDICT r2 items 3/9).
+
+Reference parity: rllib/core/models/catalog.py:33 (conv encoder choice),
+rllib/connectors/connector_v2.py:31 (pipelines),
+rllib/execution/segment_tree.py (PER), rllib/algorithms/appo, sac,
+rllib/utils/metrics/metrics_logger.py.
+"""
+
+import sys
+
+import cloudpickle
+import numpy as np
+import pytest
+
+import ray_tpu
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+# ---------------------------------------------------------------- connectors
+
+def test_frame_stack_connector():
+    from ray_tpu.rllib.connectors import FrameStack
+
+    fs = FrameStack(3)
+    fs.reset(2)
+    f1 = np.ones((2, 4, 4, 1), np.float32)
+    out = fs(f1)
+    assert out.shape == (2, 4, 4, 3)
+    assert (out == 1).all()  # fresh stack repeats the first frame
+    f2 = np.full((2, 4, 4, 1), 2, np.float32)
+    out = fs(f2, dones=np.array([False, False]))
+    assert (out[..., -1] == 2).all() and (out[..., 0] == 1).all()
+    # env 0 done: its stack restarts from the reset frame
+    f3 = np.full((2, 4, 4, 1), 3, np.float32)
+    out = fs(f3, dones=np.array([True, False]))
+    assert (out[0, ..., 0] == 3).all()  # reset stack
+    assert (out[1, ..., 0] == 1).all()  # ongoing stack keeps history
+
+
+def test_normalize_and_pipeline_shapes():
+    from ray_tpu.rllib.connectors import default_env_to_module
+
+    pipe = default_env_to_module((10, 10, 1), framestack=4)
+    assert pipe.output_shape((10, 10, 1)) == (10, 10, 4)
+    pipe.reset(3)
+    obs = np.full((3, 10, 10, 1), 255, np.uint8)
+    out = pipe(obs)
+    assert out.dtype == np.float32 and out.max() == 1.0
+    assert out.shape == (3, 10, 10, 4)
+    vec = default_env_to_module((4,))
+    assert vec.output_shape((4,)) == (4,)
+
+
+def test_gae_learner_connector_matches_direct():
+    from ray_tpu.rllib.connectors import GeneralAdvantageEstimation
+    from ray_tpu.rllib.learner import compute_gae
+
+    rng = np.random.RandomState(0)
+    sample = {
+        "rewards": rng.rand(8, 3).astype(np.float32),
+        "values": rng.rand(8, 3).astype(np.float32),
+        "dones": rng.rand(8, 3) > 0.8,
+        "last_values": rng.rand(3).astype(np.float32),
+    }
+    out = GeneralAdvantageEstimation(0.99, 0.95)(sample)
+    adv, tgt = compute_gae(sample["rewards"], sample["values"],
+                           sample["dones"], sample["last_values"], 0.99, 0.95)
+    np.testing.assert_allclose(out["advantages"], adv)
+    np.testing.assert_allclose(out["value_targets"], tgt)
+
+
+# ---------------------------------------------------------------- catalog
+
+def test_catalog_picks_conv_for_images():
+    import jax
+
+    from ray_tpu.rllib.catalog import Catalog
+    from ray_tpu.rllib.models import forward, init_actor_critic
+
+    params = init_actor_critic(jax.random.PRNGKey(0), (10, 10, 2), 3)
+    assert "conv" in params["encoder"]
+    obs = np.zeros((5, 10, 10, 2), np.float32)
+    logits, value = jax.jit(forward)(params, obs)
+    assert logits.shape == (5, 3) and value.shape == (5,)
+    # vector spaces get the MLP encoder
+    vec = init_actor_critic(jax.random.PRNGKey(0), (8,), 4)
+    assert "mlp" in vec["encoder"]
+    assert Catalog.is_image((84, 84, 4)) and not Catalog.is_image((6,))
+
+
+# ---------------------------------------------------------------- PER
+
+def test_sum_tree_proportional_sampling():
+    from ray_tpu.rllib.replay import SumTree
+
+    t = SumTree(8)
+    t.set(np.arange(4), [1.0, 2.0, 3.0, 4.0])
+    assert t.total() == 10.0
+    rng = np.random.default_rng(0)
+    counts = np.zeros(8)
+    for _ in range(200):
+        idx = t.sample(rng.random(50) * t.total())
+        np.add.at(counts, idx, 1)
+    freq = counts[:4] / counts.sum()
+    np.testing.assert_allclose(freq, [0.1, 0.2, 0.3, 0.4], atol=0.02)
+    assert counts[4:].sum() == 0  # zero-mass leaves never sampled
+
+
+def test_prioritized_buffer_priorities_shift_sampling():
+    from ray_tpu.rllib.replay import PrioritizedReplayBuffer
+
+    buf = PrioritizedReplayBuffer(64, alpha=1.0, seed=0)
+    buf.add_batch({"x": np.arange(32, dtype=np.float32)})
+    # boost priority of item 7 massively
+    buf.update_priorities(np.array([7]), np.array([100.0]))
+    batch = buf.sample(256)
+    frac7 = float((batch["x"] == 7).mean())
+    assert frac7 > 0.5, frac7
+    # importance weights compensate: the over-sampled item carries a
+    # smaller weight ((N*P)^-beta normalized; beta=0.4 default)
+    assert batch["weights"].max() == 1.0
+    w7 = batch["weights"][batch["x"] == 7]
+    w_rest = batch["weights"][batch["x"] != 7]
+    assert (w7 < 0.3).all() and (w_rest == 1.0).all()
+
+
+def test_dqn_with_prioritized_replay_smoke():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from ray_tpu.rllib.dqn import DQNConfig
+
+    algo = DQNConfig().environment("CartPole-v1").training(
+        prioritized_replay=True, num_steps_sampled_before_learning=200,
+        updates_per_iteration=8, epsilon_decay_steps=2000).build()
+    losses = []
+    for _ in range(12):
+        r = algo.train()
+        if not np.isnan(r["learner/td_loss"]):
+            losses.append(r["learner/td_loss"])
+    algo.stop()
+    assert losses, "no learner updates ran"
+
+
+# ---------------------------------------------------------------- metrics
+
+def test_metrics_logger_windows_and_lifetime():
+    from ray_tpu.rllib.metrics import MetricsLogger
+
+    m = MetricsLogger()
+    for i in range(10):
+        m.log_value("loss", float(i), window=4)
+        m.log_value(("env", "steps"), 100, reduce="sum", window=None)
+        m.log_value(("env", "return_max"), float(i), reduce="max")
+    out = m.reduce()
+    assert out["loss"] == pytest.approx(np.mean([6, 7, 8, 9]))
+    assert out["env"]["steps"] == 1000
+    assert out["env"]["return_max"] == 9.0
+    assert m.peek(("env", "steps")) == 1000
+
+
+# ---------------------------------------------------------------- pixel PPO
+
+def test_ppo_pixel_env_with_learner_mesh():
+    """PPO with the conv catalog + frame-stack connector LEARNS a pixel
+    env, with the update jitted over a 4-device learner mesh (the
+    BASELINE 'CartPole -> Atari-class' capability, num_learners=4)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from ray_tpu.rllib.ppo import PPOConfig
+
+    cfg = (PPOConfig().environment("PixelCatch-v0")
+           .env_runners(num_env_runners=0, num_envs_per_env_runner=32,
+                        rollout_fragment_length=40)
+           .learners(num_learners=4)
+           .training(lr=2.5e-3, framestack=2, entropy_coeff=0.02,
+                     num_sgd_iter=6, minibatch_size=256, gamma=0.95))
+    algo = cfg.build()
+    first, last = None, None
+    for i in range(45):
+        r = algo.train()
+        if not np.isnan(r["episode_return_mean"]):
+            if first is None:
+                first = r["episode_return_mean"]
+            last = r["episode_return_mean"]
+    algo.stop()
+    assert first is not None and last is not None
+    assert last > 2.0, f"conv PPO failed to learn: first={first} last={last}"
+    assert last > first + 2.0
+    # hierarchical metrics recorded the run
+    tree = algo.metrics.reduce()
+    assert "learner" in tree and "env_runners" in tree
+
+
+# ---------------------------------------------------------------- APPO
+
+def test_appo_solves_cartpole():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from ray_tpu.rllib.appo import APPOConfig
+
+    algo = (APPOConfig().environment("CartPole-v1")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=16,
+                         rollout_fragment_length=64)
+            .training(lr=1e-3, entropy_coeff=0.01, use_kl_loss=True)
+            .build())
+    import time
+
+    t0 = time.time()
+    best = -np.inf
+    while time.time() - t0 < 220:
+        r = algo.train()
+        if not np.isnan(r["episode_return_mean"]):
+            best = max(best, r["episode_return_mean"])
+        if best > 150:
+            break
+    algo.stop()
+    assert best > 150, f"APPO best return {best}"
+    assert algo._appo_updates > 0
+
+
+# ---------------------------------------------------------------- SAC
+
+def test_sac_improves_on_pendulum():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from ray_tpu.rllib.sac import SACConfig
+
+    algo = SACConfig().training(
+        seed=1, num_envs=4, rollout_fragment_length=16,
+        updates_per_iteration=48,
+        num_steps_sampled_before_learning=1000).build()
+    early, late = [], []
+    for i in range(160):
+        r = algo.train()
+        ret = r["episode_return_mean"]
+        if not np.isnan(ret):
+            (early if i < 60 else late).append(ret)
+    algo.stop()
+    assert np.mean(late[-20:]) > np.mean(early[:20]) + 300, \
+        (np.mean(early[:20]), np.mean(late[-20:]))
+    assert 0 < r["alpha"] < 1.0  # temperature auto-tuned down
