@@ -74,8 +74,11 @@ def main():
     on_tpu = devices[0].platform not in ("cpu",)
 
     if on_tpu:
+        import os
+
         cfg = GPT2Config.small()
-        batch_per_chip, seq = 8, 1024
+        batch_per_chip = int(os.environ.get("RAY_TPU_BENCH_BATCH", "8"))
+        seq = 1024
         steps, warmup = 20, 3
     else:  # CPU smoke path so bench.py always emits a line
         cfg = GPT2Config.tiny()

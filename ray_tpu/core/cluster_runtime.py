@@ -70,6 +70,18 @@ class _Context(threading.local):
     def __init__(self):
         self.actor_id = None
         self.task_id = None
+        # active trace context (OTel-style span propagation — reference:
+        # tracing_helper.py:34 _inject_tracing_into_function)
+        self.trace = None
+
+
+def _child_trace(parent: dict | None) -> dict:
+    span_id = os.urandom(8).hex()
+    if parent is None:
+        return {"trace_id": os.urandom(16).hex(), "span_id": span_id,
+                "parent_id": None}
+    return {"trace_id": parent["trace_id"], "span_id": span_id,
+            "parent_id": parent["span_id"]}
 
 
 class _HeldLease:
@@ -941,6 +953,7 @@ class ClusterRuntime:
             bundle_index=opts.placement_group_bundle_index,
             label_selector=opts.label_selector,
             runtime_env=self._normalized_runtime_env(opts.runtime_env),
+            trace=_child_trace(self._ctx.trace),
         )
         with self._lock:
             for o in oids:
@@ -1317,6 +1330,7 @@ class ClusterRuntime:
             resources=opts.resource_request(),
             max_restarts=opts.max_restarts,
             max_concurrency=opts.max_concurrency,
+            concurrency_groups=opts.concurrency_groups,
             lifetime=opts.lifetime,
             placement_group=pg.id.binary() if pg is not None else None,
             bundle_index=opts.placement_group_bundle_index,
@@ -1377,6 +1391,9 @@ class ClusterRuntime:
             "oids": [o.binary() for o in oids],
             "owner": self.address,
         }
+        if mopts.get("concurrency_group"):
+            msg["concurrency_group"] = mopts["concurrency_group"]
+        msg["trace"] = _child_trace(self._ctx.trace)
         # At-most-once by default (reference: actor tasks are not retried
         # unless max_task_retries>0, python/ray/actor.py): once a push may
         # have been DELIVERED (it timed out rather than failing to send),

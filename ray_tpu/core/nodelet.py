@@ -76,6 +76,14 @@ class _Lease:
 LEASE_TTL_S = 30.0
 
 
+def _fpq(x: float) -> float:
+    """Quantize a resource quantity to 1/10000 (reference: FixedPoint
+    arithmetic, src/ray/common/scheduling/fixed_point.h) so repeated
+    fractional acquire/release (0.1 CPU) cannot drift the ledger."""
+    return round(x * 10000.0) / 10000.0
+
+
+
 class Nodelet:
     def __init__(self, head_address: str, resources: dict[str, float],
                  labels: dict[str, str] | None = None,
@@ -167,6 +175,8 @@ class Nodelet:
         s.register("renew_leases", self._h_renew_leases, oneway=True)
         s.register("lease_demand", self._h_lease_demand, oneway=True)
         s.register("node_info", self._h_node_info)
+        s.register("list_logs", self._h_list_logs)
+        s.register("tail_log", self._h_tail_log)
         s.register("ping", lambda m, f: "pong")
 
         self._threads = [
@@ -221,6 +231,42 @@ class Nodelet:
         self.server.stop()
         self.store.close()
         self.store.unlink()
+
+    # ------------------------------------------------------------ logs
+    # Log streaming (reference: the dashboard log monitor,
+    # python/ray/_private/log_monitor.py:103 — per-node agent tails
+    # worker logs for the dashboard/CLI; here the nodelet serves them).
+
+    def _h_list_logs(self, msg, frames):
+        out = []
+        try:
+            for name in sorted(os.listdir(self.log_dir)):
+                path = os.path.join(self.log_dir, name)
+                if os.path.isfile(path):
+                    out.append({"file": name,
+                                "size": os.path.getsize(path)})
+        except OSError:
+            pass
+        return {"logs": out}
+
+    def _h_tail_log(self, msg, frames):
+        """Tail a log file. `offset` (-1 = from the end minus nbytes)
+        enables incremental follow — the caller passes the returned
+        `end_offset` back to stream only new bytes."""
+        name = os.path.basename(msg["file"])  # no path traversal
+        path = os.path.join(self.log_dir, name)
+        nbytes = int(msg.get("nbytes", 64 * 1024))
+        offset = int(msg.get("offset", -1))
+        try:
+            size = os.path.getsize(path)
+            start = max(0, size - nbytes) if offset < 0 else min(offset, size)
+            with open(path, "rb") as f:
+                f.seek(start)
+                data = f.read(nbytes)
+            return {"ok": True, "end_offset": start + len(data),
+                    "size": size}, [data]
+        except OSError as e:
+            return {"ok": False, "error": str(e)}
 
     def _h_lease_demand(self, msg, frames):
         owner = msg.get("owner")
@@ -374,7 +420,7 @@ class Nodelet:
                     return {"granted": False, "reason": "worker-cap"}
             # acquire before the (slow) spawn so racing submitters spill
             for r, q in resources.items():
-                self._available[r] -= q
+                self._available[r] = _fpq(self._available[r] - q)
             if w is not None:
                 w.idle = False
                 w.lease_id = lease_id  # claim inside THIS lock hold
@@ -382,7 +428,7 @@ class Nodelet:
             with self._lock:
                 for r, q in resources.items():
                     self._available[r] = min(self.resources.get(r, 0.0),
-                                             self._available[r] + q)
+                                             _fpq(self._available[r] + q))
         if w is None:
             try:
                 w = self._spawn_worker(tpu=needs_tpu, runtime_env=runtime_env,
@@ -505,7 +551,7 @@ class Nodelet:
             acquired, w.acquired = w.acquired, {}
             for r, q in acquired.items():
                 self._available[r] = min(self.resources.get(r, 0.0),
-                                         self._available.get(r, 0.0) + q)
+                                         _fpq(self._available.get(r, 0.0) + q))
             bundle, w.bundle = w.bundle, None
             if bundle is not None:
                 key, res = bundle
@@ -709,7 +755,7 @@ class Nodelet:
             if not self._can_run(req):
                 return False
             for r, q in req.items():
-                self._available[r] -= q
+                self._available[r] = _fpq(self._available[r] - q)
             for r, q in req.items():
                 w.acquired[r] = w.acquired.get(r, 0.0) + q
             return True
@@ -797,7 +843,7 @@ class Nodelet:
                         # acquire BEFORE the (slow) worker spawn so racing
                         # submitters see the true availability and spill
                         for r, q in req.items():
-                            self._available[r] -= q
+                            self._available[r] = _fpq(self._available[r] - q)
                         if bundle_key is not None:
                             free = self._bundle_free[bundle_key]
                             for r, q in spec.resources.items():
@@ -828,7 +874,7 @@ class Nodelet:
                             for r, q in req.items():
                                 self._available[r] = min(
                                     self.resources.get(r, 0.0),
-                                    self._available[r] + q)
+                                    _fpq(self._available[r] + q))
                             if bundle_key is not None:
                                 free = self._bundle_free.get(bundle_key)
                                 if free is not None:
@@ -1129,7 +1175,7 @@ class Nodelet:
             if not self._can_run(req):
                 return {"ok": False}
             for r, q in req.items():
-                self._available[r] -= q
+                self._available[r] = _fpq(self._available[r] - q)
             self._bundles[key] = dict(req)
             self._bundle_free[key] = dict(req)
         return {"ok": True}
@@ -1142,7 +1188,7 @@ class Nodelet:
             if req:
                 for r, q in req.items():
                     self._available[r] = min(self.resources.get(r, 0.0),
-                                             self._available[r] + q)
+                                             _fpq(self._available[r] + q))
         return {"ok": True}
 
     def _h_node_info(self, msg, frames):

@@ -46,6 +46,9 @@ class ActorOptions:
     max_task_retries: int = 0
     max_concurrency: int = 1
     max_pending_calls: int = -1
+    # named concurrency groups: {group: max_concurrency}
+    # (reference: concurrency_group_manager.h:34)
+    concurrency_groups: dict[str, int] | None = None
     scheduling_strategy: Any = None
     placement_group: Any = None
     placement_group_bundle_index: int = -1
@@ -68,7 +71,7 @@ _TASK_KEYS = {f.name for f in dataclasses.fields(TaskOptions)}
 _ACTOR_KEYS = {f.name for f in dataclasses.fields(ActorOptions)}
 # accepted-but-ignored (compat shims, recorded for parity)
 _SOFT_KEYS = {"memory", "accelerator_type", "num_gpus",
-              "_metadata", "enable_task_events", "concurrency_groups"}
+              "_metadata", "enable_task_events"}
 
 
 def _normalize(d: dict) -> dict:

@@ -164,8 +164,11 @@ class RpcServer:
                 continue
             ident, msg_id, method_b, payload = parts[0], parts[1], parts[2], parts[3]
             frames = [bytes(f) for f in parts[4:]]
-            self._pool.submit(self._dispatch, ident, msg_id, method_b.decode(),
-                              payload, frames)
+            try:
+                self._pool.submit(self._dispatch, ident, msg_id,
+                                  method_b.decode(), payload, frames)
+            except RuntimeError:
+                return  # pool shut down mid-teardown: stop receiving
 
     def _dispatch(self, ident, msg_id, method, payload, frames):
         entry = self._handlers.get(method)

@@ -47,6 +47,10 @@ class TaskSpec:
     label_selector: dict | None = None
     # normalized runtime env: {"env_vars": {...}, "working_dir_key": sha}
     runtime_env: dict | None = None
+    # distributed trace context {trace_id, span_id, parent_id}
+    # (reference: opentelemetry span propagation through task submission,
+    # python/ray/util/tracing/tracing_helper.py:34)
+    trace: dict | None = None
 
 
 @dataclasses.dataclass
@@ -66,6 +70,7 @@ class ActorSpec:
     bundle_index: int = -1
     label_selector: dict | None = None
     runtime_env: dict | None = None
+    concurrency_groups: dict | None = None
 
 
 @dataclasses.dataclass

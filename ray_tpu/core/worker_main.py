@@ -41,7 +41,9 @@ class WorkerRuntime(ClusterRuntime):
                                 create=False)
         self._actor_instance = None
         self._actor_spec: ActorSpec | None = None
-        self._actor_inbox: _queue.Queue = _queue.Queue()
+        self._actor_groups: dict[str, _queue.Queue] = {}
+        self._async_loop = None
+        self._async_loop_lock = threading.Lock()
         # at-least-once dedup: callers retry actor_call on slow replies;
         # executing the same method call twice corrupts actor state
         self._seen_calls: set[bytes] = set()
@@ -138,6 +140,7 @@ class WorkerRuntime(ClusterRuntime):
             "name": name,
             "state": state,
             "type": kind,
+            "trace_id": (self._ctx.trace or {}).get("trace_id", ""),
             "duration_ms": round((time.monotonic() - t0) * 1e3, 2),
             "worker_id": self.worker_id_bytes.hex(),
             "node_id": self.node_id.hex() if self.node_id else "",
@@ -194,11 +197,14 @@ class WorkerRuntime(ClusterRuntime):
 
     def _exec_task_spec(self, spec: TaskSpec, notify_nodelet: bool):
         self._ctx.task_id = TaskID(spec.task_id)
+        # adopt the submitter's trace context so spans of nested submits
+        # link to this task (reference: tracing_helper.py:34 propagation)
+        self._ctx.trace = spec.trace
         t_start = time.monotonic()
         try:
             fn = self._fetch_fn(spec.fn_id)
             a, kw = self._decode_args(spec.args, spec.kwargs)
-            with self._events.span(spec.name, "task"):
+            with self._events.span(spec.name, "task", trace=spec.trace):
                 result = fn(*a, **kw)
             n = len(spec.return_oids)
             if n == 0:
@@ -251,8 +257,22 @@ class WorkerRuntime(ClusterRuntime):
             except Exception:
                 pass
             os._exit(1)
-        for _ in range(max(1, spec.max_concurrency)):
-            threading.Thread(target=self._actor_exec_loop, daemon=True).start()
+        # per-group scheduling queues (reference: ConcurrencyGroupManager,
+        # core_worker/transport/concurrency_group_manager.h:34 — each
+        # named group has its own executor pool so a slow group cannot
+        # block another; the unnamed default group uses max_concurrency)
+        groups = {"_default": max(1, spec.max_concurrency)}
+        for g, n in (spec.concurrency_groups or {}).items():
+            groups[g] = max(1, int(n))
+        self._actor_groups = {}
+        for g, n_threads in groups.items():
+            q: _queue.Queue = _queue.Queue()
+            self._actor_groups[g] = q
+            for _ in range(n_threads):
+                threading.Thread(target=self._actor_exec_loop, args=(q,),
+                                 daemon=True,
+                                 name=f"actor-exec-{g}").start()
+        self._async_loop = None  # created on first async method call
         self.client.send_oneway(self.head_address, "actor_ready",
                                 {"actor_id": spec.actor_id,
                                  "address": self.address})
@@ -271,15 +291,39 @@ class WorkerRuntime(ClusterRuntime):
                     for old in self._seen_calls_order[:10000]:
                         self._seen_calls.discard(old)
                     del self._seen_calls_order[:10000]
-        self._actor_inbox.put(msg)
+        group = msg.get("concurrency_group") or "_default"
+        q = self._actor_groups.get(group)
+        if q is None:
+            q = self._actor_groups["_default"]
+        q.put(msg)
         return {"queued": True}
 
-    def _actor_exec_loop(self):
+    def _ensure_async_loop(self):
+        """Dedicated asyncio loop thread for `async def` actor methods
+        (reference: async actors run on an event loop and complete OUT OF
+        ORDER, core_worker/transport/out_of_order_actor_scheduling_queue.h).
+        Locked: concurrent first calls from different group executors
+        must share ONE loop (two loops break asyncio primitives bound to
+        the first)."""
+        with self._async_loop_lock:
+            if self._async_loop is None:
+                import asyncio
+
+                loop = asyncio.new_event_loop()
+                threading.Thread(target=loop.run_forever, daemon=True,
+                                 name="actor-async-loop").start()
+                self._async_loop = loop
+            return self._async_loop
+
+    def _actor_exec_loop(self, inbox: _queue.Queue):
         # execution threads carry the actor identity so user code can ask
         # get_runtime_context() (reference: worker context per thread)
         self._ctx.actor_id = ActorID(self._actor_spec.actor_id)
+        import asyncio
+        import inspect
+
         while True:
-            msg = self._actor_inbox.get()
+            msg = inbox.get()
             if msg is None:
                 return
             owner = msg["owner"]
@@ -287,27 +331,53 @@ class WorkerRuntime(ClusterRuntime):
             mname = msg["method"]
             task_id = msg.get("task_id", b"")
             self._ctx.task_id = TaskID(task_id) if task_id else None
+            self._ctx.trace = msg.get("trace")
             t_start = time.monotonic()
+            label = f"{type(self._actor_instance).__name__}.{mname}"
             try:
                 a, kw = self._decode_args(msg["args"], msg["kwargs"])
                 fn = getattr(self._actor_instance, mname)
-                with self._events.span(
-                        f"{type(self._actor_instance).__name__}.{mname}",
-                        "actor_task"):
+                if inspect.iscoroutinefunction(fn):
+                    # async method: schedule on the event loop and move on
+                    # — completions land out of submission order while
+                    # this group's thread keeps draining its queue
+                    loop = self._ensure_async_loop()
+                    fut = asyncio.run_coroutine_threadsafe(
+                        fn(*a, **kw), loop)
+                    fut.add_done_callback(
+                        self._make_async_done(owner, task_id, oids, label,
+                                              t_start))
+                    continue
+                with self._events.span(label, "actor_task",
+                                       trace=msg.get("trace")):
                     result = fn(*a, **kw)
                 n = len(oids)
                 values = [result] if n == 1 else (list(result) if n else [])
                 self._ship_results(owner, task_id, oids, values)
-                self._report_task_event(
-                    task_id, f"{type(self._actor_instance).__name__}.{mname}",
-                    "FINISHED", t_start, "ACTOR_TASK")
+                self._report_task_event(task_id, label, "FINISHED", t_start,
+                                        "ACTOR_TASK")
             except Exception as e:  # noqa: BLE001
-                err = exc.TaskError.from_exception(
-                    e, f"{type(self._actor_instance).__name__}.{mname}")
+                err = exc.TaskError.from_exception(e, label)
                 self._ship_error(owner, task_id, oids, err)
-                self._report_task_event(
-                    task_id, f"{type(self._actor_instance).__name__}.{mname}",
-                    "FAILED", t_start, "ACTOR_TASK")
+                self._report_task_event(task_id, label, "FAILED", t_start,
+                                        "ACTOR_TASK")
+
+    def _make_async_done(self, owner, task_id, oids, label, t_start):
+        def done(fut):
+            try:
+                result = fut.result()
+                n = len(oids)
+                values = [result] if n == 1 else (list(result) if n else [])
+                self._ship_results(owner, task_id, oids, values)
+                self._report_task_event(task_id, label, "FINISHED", t_start,
+                                        "ACTOR_TASK")
+            except Exception as e:  # noqa: BLE001
+                err = exc.TaskError.from_exception(e, label)
+                self._ship_error(owner, task_id, oids, err)
+                self._report_task_event(task_id, label, "FAILED", t_start,
+                                        "ACTOR_TASK")
+
+        return done
 
     def _h_exit(self, msg, frames):
         os._exit(0)

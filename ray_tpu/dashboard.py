@@ -99,6 +99,24 @@ def start_dashboard(head_address: str | None = None, port: int = 8265) -> int:
                 elif self.path == "/metrics":
                     self._send(metrics_mod.prometheus_text().encode(),
                                "text/plain; version=0.0.4")
+                elif self.path.startswith("/api/logs"):
+                    # /api/logs?node=<hex>[&file=<name>[&nbytes=N]]
+                    # (reference: dashboard log streaming via the log
+                    # monitor, _private/log_monitor.py:103)
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+                    node = (q.get("node") or [""])[0]
+                    fname = (q.get("file") or [None])[0]
+                    if fname is None:
+                        self._send(json.dumps(
+                            state.list_logs(node, head_address)).encode(),
+                            "application/json")
+                    else:
+                        nbytes = int((q.get("nbytes") or ["65536"])[0])
+                        text, _ = state.tail_log(node, fname, nbytes,
+                                                 address=head_address)
+                        self._send(text.encode(), "text/plain")
                 else:
                     self._send(b"not found", "text/plain", 404)
             except Exception as e:  # noqa: BLE001

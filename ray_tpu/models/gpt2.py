@@ -195,7 +195,37 @@ def gpt2_forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Arra
 
     block = _block
     if cfg.remat:
-        block = jax.checkpoint(_block, static_argnums=(2,))
+        # RAY_TPU_REMAT_POLICY selects what the backward replay reuses:
+        # "full" (default) recomputes everything; "save_flash" keeps the
+        # flash kernel's (o, lse); "save_dots" keeps all matmul outputs;
+        # "none" disables remat.
+        import os as _os
+
+        # Default "full" is MEASURED fastest on v5e-class chips for
+        # GPT-2-small (see PERF_NOTES.md): full recompute 0.354 MFU vs
+        # save_flash 0.338, save_dots 0.339, none 0.320 — at this
+        # model size the HBM traffic of saving residuals costs more
+        # than the recompute FLOPs. Larger models (activation-bound)
+        # should flip to save_flash/save_dots via this env lever.
+        mode = _os.environ.get("RAY_TPU_REMAT_POLICY", "full")
+        if mode == "save_flash":
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "flash_o", "flash_lse")
+            block = jax.checkpoint(_block, static_argnums=(2,),
+                                   policy=policy)
+        elif mode == "save_dots":
+            # save every matmul output AND the flash residuals: the
+            # replay only redoes elementwise work (LN/gelu)
+            policy = jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names(
+                    "flash_o", "flash_lse"))
+            block = jax.checkpoint(_block, static_argnums=(2,),
+                                   policy=policy)
+        elif mode == "none":
+            pass  # no remat: all activations saved
+        else:  # "full": recompute everything
+            block = jax.checkpoint(_block, static_argnums=(2,))
 
     def body(carry, layer_params):
         return block(carry, layer_params, cfg), None

@@ -297,7 +297,16 @@ def _flash(q, k, v, cfg: _Cfg):
 
 def _flash_fwd(q, k, v, cfg: _Cfg):
     o, lse = _fwd(q, k, v, cfg)
-    return o, (q, k, v, o, lse)
+    # Name the kernel outputs so a remat policy can SAVE them: under
+    # jax.checkpoint(block) the backward replay would otherwise re-run
+    # this pallas forward just to rebuild (o, lse) residuals — the
+    # lse-saving policy (models.gpt2 remat_policy="save_flash") keeps
+    # them and the replay's flash fwd is dead-code-eliminated.
+    from jax.ad_checkpoint import checkpoint_name
+
+    o_res = checkpoint_name(o, "flash_o")
+    lse = checkpoint_name(lse, "flash_lse")
+    return o, (q, k, v, o_res, lse)
 
 
 def _flash_bwd(cfg: _Cfg, res, do):

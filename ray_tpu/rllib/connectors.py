@@ -86,14 +86,17 @@ class FrameStack(ConnectorV2):
     def __call__(self, obs, dones=None):
         obs = np.asarray(obs)
         n, h, w, c = obs.shape
+        # frame-major layout [f0|f1|...]: np.tile repeats WHOLE frames,
+        # matching the shift path; np.repeat would interleave channels
+        # and scramble multi-channel stacks
         if self._stacks is None or self._stacks.shape[0] != n:
-            self._stacks = np.repeat(obs, self.k, axis=-1)
+            self._stacks = np.tile(obs, (1, 1, 1, self.k))
         else:
             shifted = np.concatenate([self._stacks[..., c:], obs], axis=-1)
             if dones is not None and dones.any():
                 # obs[dones] is the new episode's FIRST frame (next-step
                 # autoreset): restart those stacks, don't mix episodes
-                shifted[dones] = np.repeat(obs[dones], self.k, axis=-1)
+                shifted[dones] = np.tile(obs[dones], (1, 1, 1, self.k))
             self._stacks = shifted
         return self._stacks.copy()
 

@@ -13,12 +13,18 @@ from typing import Any
 
 
 class _Stat:
-    __slots__ = ("values", "reduce", "lifetime")
+    """window=None keeps O(1) LIFETIME accumulators per reduce kind —
+    never an unbounded value list."""
+
+    __slots__ = ("values", "reduce", "lifetime", "count", "windowed")
 
     def __init__(self, reduce: str, window: int | None):
         self.reduce = reduce
-        self.values = deque(maxlen=window)
-        self.lifetime = 0.0
+        self.windowed = window is not None
+        self.values = deque(maxlen=window) if self.windowed else None
+        self.lifetime = (float("inf") if reduce == "min"
+                         else float("-inf") if reduce == "max" else 0.0)
+        self.count = 0
 
 
 def _to_path(key) -> tuple:
@@ -35,18 +41,22 @@ class MetricsLogger:
 
     def log_value(self, key, value, reduce: str = "mean",
                   window: int | None = 100):
-        """reduce in {mean, sum, min, max}; window=None with reduce=sum
-        accumulates a lifetime counter (reference: lifetime stats)."""
+        """reduce in {mean, sum, min, max}; window=None means LIFETIME
+        (reference: lifetime stats) — tracked with O(1) accumulators,
+        never an unbounded buffer."""
         path = _to_path(key)
         st = self._stats.get(path)
         if st is None:
-            st = self._stats[path] = _Stat(
-                reduce, window if reduce != "sum" or window else None)
+            st = self._stats[path] = _Stat(reduce, window)
         v = float(value)
-        if st.reduce == "sum" and st.values.maxlen is None:
-            # lifetime counter: the deque is never read on this path and
-            # must not grow unboundedly over long runs
-            st.lifetime += v
+        if not st.windowed:
+            st.count += 1
+            if st.reduce == "min":
+                st.lifetime = min(st.lifetime, v)
+            elif st.reduce == "max":
+                st.lifetime = max(st.lifetime, v)
+            else:  # sum and mean both accumulate a running sum
+                st.lifetime += v
             return
         st.values.append(v)
 
@@ -63,9 +73,14 @@ class MetricsLogger:
 
     @staticmethod
     def _reduce_one(st: _Stat):
+        if not st.windowed:
+            if st.count == 0:
+                return float("nan")
+            if st.reduce == "mean":
+                return st.lifetime / st.count
+            return st.lifetime
         if st.reduce == "sum":
-            return (st.lifetime if st.values.maxlen is None
-                    else float(sum(st.values)))
+            return float(sum(st.values))
         if not st.values:
             return float("nan")
         if st.reduce == "mean":

@@ -121,6 +121,21 @@ def cmd_list(args):
     return 0
 
 
+def cmd_logs(args):
+    """Stream node logs (reference: `ray logs` over the log monitor,
+    _private/log_monitor.py:103)."""
+    from ray_tpu.util import state
+
+    if args.file is None:
+        print(json.dumps(state.list_logs(args.node, address=args.address),
+                         indent=2))
+        return 0
+    text, _ = state.tail_log(args.node, args.file, nbytes=args.nbytes,
+                             address=args.address)
+    sys.stdout.write(text)
+    return 0
+
+
 def cmd_stop(args):
     session_dir = args.session_dir
     roots = ([session_dir] if session_dir else
@@ -173,6 +188,13 @@ def main(argv=None):
     p.add_argument("kind", choices=["actors", "nodes", "pgs", "tasks"])
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("logs")
+    p.add_argument("node", help="node id (hex prefix)")
+    p.add_argument("file", nargs="?", help="log file name (omit to list)")
+    p.add_argument("--address", required=True)
+    p.add_argument("--nbytes", type=int, default=64 * 1024)
+    p.set_defaults(fn=cmd_logs)
 
     p = sub.add_parser("stop")
     p.add_argument("--session-dir")

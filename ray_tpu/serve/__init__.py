@@ -5,9 +5,10 @@ classes become groups of replica actors managed by a controller actor
 (_private/controller.py:84); requests route through a DeploymentHandle
 with least-queue replica choice (power-of-two-choices router,
 _private/router.py:318); an optional HTTP proxy exposes apps over REST
-(_private/proxy.py); load-driven replica autoscaling tracks mean
-ongoing requests (autoscaling_state.py). App graphs/deployment
-composition are future work.
+(_private/proxy.py — here a dedicated proxy ACTOR bound on the node
+IP); load-driven replica autoscaling tracks mean ongoing requests
+(autoscaling_state.py); app graphs compose deployments by binding
+Applications into init args (build_app.py:68).
 """
 
 from ray_tpu.serve.api import (
@@ -18,8 +19,10 @@ from ray_tpu.serve.api import (
     delete,
     deployment,
     get_app_handle,
+    proxy_address,
     run,
     shutdown,
+    start_proxy,
 )
 
 __all__ = [
@@ -30,6 +33,8 @@ __all__ = [
     "delete",
     "deployment",
     "get_app_handle",
+    "proxy_address",
     "run",
     "shutdown",
+    "start_proxy",
 ]

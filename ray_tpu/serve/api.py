@@ -52,9 +52,22 @@ class Deployment:
 
 @dataclasses.dataclass
 class Application:
+    """A bound deployment. Init args may contain OTHER Applications —
+    the app graph (reference: serve/_private/build_app.py:68): serve.run
+    deploys the graph bottom-up and injects DeploymentHandles for the
+    nested nodes, so replicas compose deployments at runtime."""
+
     deployment: Deployment
     init_args: tuple
     init_kwargs: dict
+
+
+@dataclasses.dataclass
+class _HandleRef:
+    """Placeholder riding through replica init args; resolved to a live
+    DeploymentHandle inside the replica process."""
+
+    app_name: str
 
 
 def deployment(_cls=None, *, name: str | None = None, num_replicas: int = 1,
@@ -79,6 +92,14 @@ class _Replica:
         import cloudpickle
 
         cls = cloudpickle.loads(cls_blob)
+        # resolve composed-deployment placeholders into live handles
+        # (reference: build_app.py injects DeploymentHandles for bound
+        # sub-apps)
+        args = tuple(get_app_handle(a.app_name)
+                     if isinstance(a, _HandleRef) else a for a in args)
+        kwargs = {k: (get_app_handle(v.app_name)
+                      if isinstance(v, _HandleRef) else v)
+                  for k, v in kwargs.items()}
         self._instance = cls(*args, **kwargs) if isinstance(cls, type) \
             else None
         self._fn = None if isinstance(cls, type) else cls
@@ -325,24 +346,41 @@ def _controller():
 
 def run(app: Application, *, name: str = "default",
         http_port: int | None = None) -> DeploymentHandle:
-    """Deploy an application; returns its handle (reference: serve.run)."""
+    """Deploy an application — including its composed sub-deployments,
+    bottom-up (reference: serve.run -> build_app.py:68). Returns the
+    ingress deployment's handle. `http_port` starts the proxy ACTOR
+    bound on this node's IP (reference: _private/proxy.py)."""
     import cloudpickle
 
     import ray_tpu
 
-    ctrl = _controller()
-    dep = app.deployment
-    blob = cloudpickle.dumps(dep.cls_or_fn)
-    autoscaling = (dataclasses.asdict(dep.autoscaling_config)
-                   if dep.autoscaling_config else None)
-    ray_tpu.get(ctrl.deploy.remote(
-        name, blob, dep.num_replicas, dep.ray_actor_options,
-        app.init_args, app.init_kwargs, dep.max_ongoing_requests,
-        autoscaling),
-        timeout=180)
+    def deploy_graph(a: Application, app_name: str):
+        dep = a.deployment
+        # bottom-up: nested Applications become named child apps whose
+        # handles are injected into this deployment's init args
+        def resolve(v):
+            if isinstance(v, Application):
+                child = f"{app_name}--{v.deployment.name}"
+                deploy_graph(v, child)
+                return _HandleRef(child)
+            return v
+
+        init_args = tuple(resolve(v) for v in a.init_args)
+        init_kwargs = {k: resolve(v) for k, v in a.init_kwargs.items()}
+        ctrl = _controller()
+        blob = cloudpickle.dumps(dep.cls_or_fn)
+        autoscaling = (dataclasses.asdict(dep.autoscaling_config)
+                       if dep.autoscaling_config else None)
+        ray_tpu.get(ctrl.deploy.remote(
+            app_name, blob, dep.num_replicas, dep.ray_actor_options,
+            init_args, init_kwargs, dep.max_ongoing_requests,
+            autoscaling),
+            timeout=180)
+
+    deploy_graph(app, name)
     handle = get_app_handle(name)
     if http_port is not None:
-        _start_http_proxy(http_port)
+        start_proxy(http_port)
     return handle
 
 
@@ -379,53 +417,105 @@ def shutdown():
 
 # ---------------------------------------------------------------- HTTP
 
-_http_server = None
-_http_thread = None
+_PROXY_NAME = "__serve_proxy"
 
 
-def _start_http_proxy(port: int):
-    """JSON-over-HTTP ingress in the driver process (reference: per-node
-    Proxy actors, _private/proxy.py; single proxy suffices single-host).
-    POST /<app> with a JSON body calls the app handle."""
-    global _http_server, _http_thread
-    import json
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+class ProxyActor:
+    """HTTP ingress as an ACTOR bound on the node IP — not a thread in
+    the driver process (reference: per-node Proxy actors,
+    _private/proxy.py). POST /<app> with a JSON body calls the app
+    handle; threads serve requests concurrently, each awaiting its own
+    ObjectRef, so one slow deployment call does not serialize the
+    ingress. Handle objects are cached per app (they refresh their
+    replica sets themselves)."""
 
+    def __init__(self, port: int):
+        import json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        import ray_tpu
+        from ray_tpu.core.rpc import node_ip
+
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            daemon_threads = True
+
+            def do_POST(self):
+                app = self.path.strip("/") or "default"
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                try:
+                    payload = json.loads(body) if body else None
+                    ref = proxy._handle(app).remote(payload)
+                    result = ray_tpu.get(ref, timeout=120)
+                    out = json.dumps({"result": result}).encode()
+                    self.send_response(200)
+                except Exception as e:  # noqa: BLE001
+                    out = json.dumps({"error": repr(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        ip = node_ip()
+        bind_host = "" if ip != "127.0.0.1" else "127.0.0.1"
+        self._server = ThreadingHTTPServer((bind_host, port), Handler)
+        self._server.daemon_threads = True
+        self.address = f"{ip}:{self._server.server_address[1]}"
+        self._handles: dict[str, DeploymentHandle] = {}
+        self._hlock = threading.Lock()
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="serve-proxy-http").start()
+
+    def _handle(self, app: str) -> DeploymentHandle:
+        with self._hlock:
+            h = self._handles.get(app)
+        if h is None:
+            h = get_app_handle(app)
+            with self._hlock:
+                self._handles[app] = h
+        return h
+
+    def get_address(self) -> str:
+        return self.address
+
+    def stop(self) -> bool:
+        self._server.shutdown()
+        return True
+
+
+def start_proxy(port: int = 8000) -> str:
+    """Start (or find) the ingress proxy actor; returns 'ip:port'."""
     import ray_tpu
 
-    class Handler(BaseHTTPRequestHandler):
-        def do_POST(self):
-            app = self.path.strip("/") or "default"
-            length = int(self.headers.get("Content-Length", 0))
-            body = self.rfile.read(length)
-            try:
-                payload = json.loads(body) if body else None
-                handle = get_app_handle(app)
-                ref = handle.remote(payload)
-                result = ray_tpu.get(ref, timeout=120)
-                out = json.dumps({"result": result}).encode()
-                self.send_response(200)
-            except Exception as e:  # noqa: BLE001
-                out = json.dumps({"error": repr(e)}).encode()
-                self.send_response(500)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(out)))
-            self.end_headers()
-            self.wfile.write(out)
+    cls = ray_tpu.remote(num_cpus=0)(ProxyActor)
+    proxy = cls.options(name=_PROXY_NAME, get_if_exists=True,
+                        max_concurrency=4).remote(port)
+    return ray_tpu.get(proxy.get_address.remote(), timeout=60)
 
-        def log_message(self, *a):  # quiet
-            pass
 
-    _http_server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
-    _http_thread = threading.Thread(target=_http_server.serve_forever,
-                                    daemon=True, name="serve-http")
-    _http_thread.start()
+def proxy_address() -> str:
+    import ray_tpu
+
+    proxy = ray_tpu.get_actor(_PROXY_NAME)
+    return ray_tpu.get(proxy.get_address.remote(), timeout=30)
 
 
 def _stop_http_proxy():
-    global _http_server, _http_thread
-    if _http_server is not None:
-        _http_server.shutdown()
-        _http_server = None
-        _http_thread = None
+    import ray_tpu
+
+    try:
+        proxy = ray_tpu.get_actor(_PROXY_NAME)
+    except Exception:  # noqa: BLE001
+        return
+    try:
+        ray_tpu.get(proxy.stop.remote(), timeout=30)
+        ray_tpu.kill(proxy)
+    except Exception:  # noqa: BLE001
+        pass
 

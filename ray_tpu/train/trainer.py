@@ -50,6 +50,11 @@ class ScalingConfig:
     # (tests / laptops; None on real TPU workers)
     num_cpu_devices_per_worker: int | None = None
     min_workers: int | None = None  # elastic floor (None = fixed size)
+    # mid-run elastic: how often the result loop re-evaluates the
+    # scaling decision against live capacity (reference: Train v2's
+    # continuous ScalingPolicy, scaling_policy.py:26). 0 disables —
+    # sizing then happens only at gang (re)starts.
+    elastic_interval_s: float = 0.0
 
     def decide_num_workers(self) -> int:
         """Elastic sizing decision against the live resource view."""
@@ -65,6 +70,19 @@ class ScalingConfig:
                 # epsilon guards float residue from fractional releases
                 fit = min(fit, int((avail.get(r, 0.0) + 1e-9) // q))
         return max(self.min_workers, min(self.num_workers, fit))
+
+    def extra_capacity(self) -> int:
+        """How many MORE workers the cluster could place right now (the
+        running gang's own resources are already subtracted from the
+        availability view)."""
+        import ray_tpu
+
+        avail = ray_tpu.available_resources()
+        fit = 1 << 30
+        for r, q in self.worker_resources().items():
+            if q > 0:
+                fit = min(fit, int((avail.get(r, 0.0) + 1e-9) // q))
+        return max(0, fit)
 
     def worker_resources(self) -> dict[str, float]:
         if self.resources_per_worker is not None:
@@ -144,17 +162,30 @@ class JaxTrainer:
         failure_config = self.run_config.failure_config or FailureConfig()
 
         resume = self._resume or manager.latest()
+        resize_to = None
         failures = 0
         history: list[dict] = []
         last_error: BaseException | None = None
         while True:
             wg = None
             try:
-                wg = self._start_worker_group(name, exp_dir, resume)
+                wg = self._start_worker_group(name, exp_dir, resume,
+                                              resize_to)
+                resize_to = None
                 metrics, ckpt = self._result_loop(wg, manager, history)
                 return Result(metrics=metrics, checkpoint=ckpt or
                               manager.latest(), path=exp_dir,
                               metrics_history=history)
+            except _ElasticResize as e:
+                # mid-run scaling decision: controlled gang restart from
+                # the latest checkpoint at a result boundary (does not
+                # consume the failure budget — reference: Train v2
+                # ScalingPolicy resize decisions, scaling_policy.py:26).
+                # The TARGET rides along: the availability view right
+                # after shutdown is stale (old workers still releasing),
+                # so re-deciding from it would undo the resize.
+                resume = manager.latest()
+                resize_to = e.target
             except (WorkerGroupError, _WorkerFailure) as e:
                 last_error = e
                 failures += 1
@@ -170,9 +201,10 @@ class JaxTrainer:
     # ------------------------------------------------------------------
 
     def _start_worker_group(self, name: str, exp_dir: str,
-                            resume: Checkpoint | None) -> WorkerGroup:
+                            resume: Checkpoint | None,
+                            num_override: int | None = None) -> WorkerGroup:
         sc = self.scaling_config
-        n_workers = sc.decide_num_workers()
+        n_workers = num_override or sc.decide_num_workers()
         wg = WorkerGroup(
             num_workers=n_workers,
             resources_per_worker=sc.worker_resources(),
@@ -318,7 +350,20 @@ class JaxTrainer:
         last_metrics: dict = {}
         last_ckpt: Checkpoint | None = None
         finished: set[int] = set()
+        sc = self.scaling_config
+        next_elastic_check = (time.monotonic() + sc.elastic_interval_s
+                              if sc.elastic_interval_s > 0 else None)
         while len(finished) < wg.num_workers:
+            if next_elastic_check is not None and \
+                    time.monotonic() >= next_elastic_check:
+                next_elastic_check = time.monotonic() + sc.elastic_interval_s
+                want = min(sc.num_workers,
+                           wg.num_workers + sc.extra_capacity())
+                if want > wg.num_workers and last_ckpt is not None:
+                    # capacity appeared: grow the gang at a checkpointed
+                    # boundary (shrink happens via the failure path when
+                    # a worker is lost)
+                    raise _ElasticResize(wg.num_workers, want)
             round_reports: dict[int, dict] = {}
             for rank in range(wg.num_workers):
                 if rank in finished:
@@ -370,3 +415,10 @@ class _WorkerFailure(RuntimeError):
     def __init__(self, msg, rank):
         super().__init__(msg)
         self.rank = rank
+
+
+class _ElasticResize(Exception):
+    def __init__(self, current: int, target: int):
+        super().__init__(f"elastic resize {current} -> {target}")
+        self.current = current
+        self.target = target

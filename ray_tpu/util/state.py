@@ -49,6 +49,38 @@ def list_tasks(address: str | None = None, limit: int = 1000) -> list[dict]:
                       address=address)["tasks"]
 
 
+def _node_address(node_id: str, address: str | None) -> str:
+    for n in list_nodes(address):
+        if n["node_id"].startswith(node_id) and n["alive"]:
+            return n["address"]
+    raise ValueError(f"no live node matching {node_id!r}")
+
+
+def list_logs(node_id: str, address: str | None = None) -> list[dict]:
+    """Log files on a node (reference: `ray logs` / the dashboard log
+    monitor, _private/log_monitor.py:103)."""
+    from ray_tpu.core.rpc import RpcClient
+
+    target = _node_address(node_id, address)
+    return RpcClient.shared().call(target, "list_logs", {},
+                                   timeout=30)["logs"]
+
+
+def tail_log(node_id: str, file: str, nbytes: int = 64 * 1024,
+             offset: int = -1, address: str | None = None):
+    """Tail (or incrementally follow via `offset`) one log file on a
+    node. Returns (text, end_offset)."""
+    from ray_tpu.core.rpc import RpcClient
+
+    target = _node_address(node_id, address)
+    value, frames = RpcClient.shared().call_frames(
+        target, "tail_log", {"file": file, "nbytes": nbytes,
+                             "offset": offset}, timeout=30)
+    if not value.get("ok"):
+        raise FileNotFoundError(value.get("error", "log unavailable"))
+    return frames[0].decode(errors="replace"), value["end_offset"]
+
+
 def list_placement_groups(address: str | None = None) -> list[dict]:
     return _head_call("pg_table", address=address).get("groups", [])
 

@@ -16,26 +16,31 @@ class TaskEventLog:
         self._capacity = capacity
 
     @contextlib.contextmanager
-    def span(self, name: str, category: str):
+    def span(self, name: str, category: str, trace: dict | None = None):
+        """`trace` carries the propagated {trace_id, span_id, parent_id}
+        context (reference: opentelemetry span propagation,
+        ray/util/tracing/tracing_helper.py:34) — recorded as chrome-trace
+        args so cross-process spans of one logical request correlate."""
         t0 = time.monotonic_ns()
         tid = threading.get_ident()
         try:
             yield
         finally:
             t1 = time.monotonic_ns()
+            ev = {
+                "name": name,
+                "cat": category,
+                "ph": "X",
+                "ts": t0 / 1e3,
+                "dur": (t1 - t0) / 1e3,
+                "pid": 0,
+                "tid": tid,
+            }
+            if trace:
+                ev["args"] = dict(trace)
             with self._lock:
                 if len(self._events) < self._capacity:
-                    self._events.append(
-                        {
-                            "name": name,
-                            "cat": category,
-                            "ph": "X",
-                            "ts": t0 / 1e3,
-                            "dur": (t1 - t0) / 1e3,
-                            "pid": 0,
-                            "tid": tid,
-                        }
-                    )
+                    self._events.append(ev)
 
     def chrome_trace(self, filename: str | None = None):
         with self._lock:

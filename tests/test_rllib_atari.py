@@ -39,6 +39,24 @@ def test_frame_stack_connector():
     assert (out[1, ..., 0] == 1).all()  # ongoing stack keeps history
 
 
+def test_frame_stack_multichannel_layout():
+    """Frame-major stacking: whole frames tile (np.tile), channels never
+    interleave (c=2 regression for the np.repeat bug)."""
+    from ray_tpu.rllib.connectors import FrameStack
+
+    fs = FrameStack(2)
+    fs.reset(1)
+    f1 = np.zeros((1, 2, 2, 2), np.float32)
+    f1[..., 0], f1[..., 1] = 1, 2  # channels A=1, B=2
+    out = fs(f1)
+    assert out.shape == (1, 2, 2, 4)
+    np.testing.assert_array_equal(out[0, 0, 0], [1, 2, 1, 2])  # [A,B|A,B]
+    f2 = np.zeros((1, 2, 2, 2), np.float32)
+    f2[..., 0], f2[..., 1] = 3, 4
+    out = fs(f2, dones=np.array([False]))
+    np.testing.assert_array_equal(out[0, 0, 0], [1, 2, 3, 4])
+
+
 def test_normalize_and_pipeline_shapes():
     from ray_tpu.rllib.connectors import default_env_to_module
 
