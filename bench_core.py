@@ -189,7 +189,8 @@ def main():
                  "memcpy bandwidth (~1.5-8 GB/s measured via "
                  "bytearray-to-bytearray copies) — the put path is a "
                  "single copy into shared memory, so it tracks memcpy; "
-                 "zero-copy reads are why get_calls is 68x baseline."),
+                 "zero-copy reads are why get_calls lands orders of "
+                 "magnitude above baseline."),
     }
     with open("CORE_BENCH.json", "w") as f:
         json.dump(report, f, indent=1)

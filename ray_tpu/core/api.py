@@ -300,6 +300,13 @@ class ActorMethod:
             self._handle._actor_id, self._name, args, kwargs, self._opts
         )
 
+    def bind(self, *args):
+        """Bind into a compiled DAG (reference: ray.dag —
+        actor.method.bind(node), dag/class_node.py)."""
+        from ray_tpu.dag import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args)
+
     def options(self, **opts):
         return ActorMethod(self._handle, self._name, {**self._opts, **opts})
 

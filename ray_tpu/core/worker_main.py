@@ -64,7 +64,10 @@ class WorkerRuntime(ClusterRuntime):
         self.server.register("execute_leased", self._h_execute_leased)
         self.server.register("become_actor", self._h_become_actor, oneway=True)
         self.server.register("actor_call", self._h_actor_call)
+        self.server.register("dag_start", self._h_dag_start)
+        self.server.register("dag_stop", self._h_dag_stop)
         self.server.register("exit_worker", self._h_exit, oneway=True)
+        self._dag_loops: dict[str, threading.Event] = {}
 
     # ------------------------------------------------------------ args
 
@@ -264,6 +267,12 @@ class WorkerRuntime(ClusterRuntime):
         groups = {"_default": max(1, spec.max_concurrency)}
         for g, n in (spec.concurrency_groups or {}).items():
             groups[g] = max(1, int(n))
+        # a plain max_concurrency=1 actor is guaranteed one-method-at-a-
+        # time; compiled-DAG loops run on their own threads and must
+        # honor that via this shared lock (no-op for concurrent actors)
+        self._serial_actor = (max(1, spec.max_concurrency) == 1
+                              and not spec.concurrency_groups)
+        self._instance_lock = threading.Lock()
         self._actor_groups = {}
         for g, n_threads in groups.items():
             q: _queue.Queue = _queue.Queue()
@@ -350,7 +359,11 @@ class WorkerRuntime(ClusterRuntime):
                     continue
                 with self._events.span(label, "actor_task",
                                        trace=msg.get("trace")):
-                    result = fn(*a, **kw)
+                    if self._serial_actor:
+                        with self._instance_lock:
+                            result = fn(*a, **kw)
+                    else:
+                        result = fn(*a, **kw)
                 n = len(oids)
                 values = [result] if n == 1 else (list(result) if n else [])
                 self._ship_results(owner, task_id, oids, values)
@@ -378,6 +391,68 @@ class WorkerRuntime(ClusterRuntime):
                                         "ACTOR_TASK")
 
         return done
+
+    # ------------------------------------------------------------ compiled DAG
+    # Reference: accelerated/compiled DAGs (dag/compiled_dag_node.py:711)
+    # — after compile, repeated executions bypass task submission
+    # entirely: each actor runs a resident loop reading its input
+    # CHANNELS, invoking the bound method directly on the hosted
+    # instance, and writing the result channel.
+
+    def _h_dag_start(self, msg, frames):
+        from ray_tpu.experimental.channel import Channel
+
+        if self._actor_instance is None:
+            raise exc.ActorUnavailableError("not an actor worker")
+        loop_id = msg["loop_id"]
+        method = msg["method"]
+        ins = [Channel(name=n, create=False) for n in msg["in_channels"]]
+        out = Channel(name=msg["out_channel"], create=False)
+        stop = threading.Event()
+        self._dag_loops[loop_id] = stop
+
+        def run():
+            fn = getattr(self._actor_instance, method)
+            while not stop.is_set():
+                try:
+                    # short poll on the FIRST input (checks `stop`); once
+                    # one arg of an execution landed the rest are in
+                    # flight, so wait them out fully — a short timeout
+                    # there would drop the already-consumed first arg
+                    first = ins[0].get(timeout=0.5)
+                except TimeoutError:
+                    continue
+                except Exception:  # noqa: BLE001
+                    return  # channel closed/destroyed: loop ends
+                try:
+                    args = [first] + [c.get(timeout=60) for c in ins[1:]]
+                except Exception:  # noqa: BLE001
+                    return
+                try:
+                    for a in args:
+                        if isinstance(a, dict) and "__dag_error__" in a:
+                            raise RuntimeError(a["__dag_error__"])
+                    if getattr(self, "_serial_actor", False):
+                        with self._instance_lock:
+                            result = fn(*args)
+                    else:
+                        result = fn(*args)
+                    out.put(result)
+                except Exception as e:  # noqa: BLE001
+                    try:
+                        out.put({"__dag_error__": f"{method}: {e!r}"})
+                    except Exception:  # noqa: BLE001
+                        return
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"dag-loop-{method}").start()
+        return {"ok": True}
+
+    def _h_dag_stop(self, msg, frames):
+        stop = self._dag_loops.pop(msg["loop_id"], None)
+        if stop is not None:
+            stop.set()
+        return {"ok": True}
 
     def _h_exit(self, msg, frames):
         os._exit(0)

@@ -1,0 +1,270 @@
+"""Compiled DAGs — channel-backed repeated execution of actor graphs.
+
+Reference parity: ray.dag accelerated DAGs
+(python/ray/dag/compiled_dag_node.py:711 — `experimental_compile` turns
+a bound actor-method graph into a resident pipeline: each actor runs a
+loop reading input CHANNELS, invoking its method directly, writing its
+output channel; `execute()` then costs one channel write + read instead
+of per-call task submission). Here the channels are the native shm SPSC
+rings (ray_tpu.experimental.channel) and the per-actor loops are
+installed by the worker runtime (dag_start).
+
+Usage:
+    with InputNode() as inp:
+        x = a.step.bind(inp)
+        y = b.step.bind(x)
+    dag = y.experimental_compile()
+    out = dag.execute(5).get()
+    dag.teardown()
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any
+
+_CHANNEL_CAP = 1 << 20
+
+
+class _DagError:
+    """Slot-consuming error marker in the result sequence."""
+
+    def __init__(self, message: str):
+        self.message = message
+
+
+class DAGNode:
+    """Base: a node producing one value per execution."""
+
+    def __init__(self, upstream: list["DAGNode"]):
+        self.upstream = upstream
+
+    def experimental_compile(self) -> "CompiledDAG":
+        return CompiledDAG(self)
+
+    def _walk(self, seen, order):
+        if id(self) in seen:
+            return
+        seen.add(id(self))
+        for u in self.upstream:
+            u._walk(seen, order)
+        order.append(self)
+
+
+class InputNode(DAGNode):
+    """The driver-fed input (reference: ray.dag.InputNode)."""
+
+    def __init__(self):
+        super().__init__([])
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class ClassMethodNode(DAGNode):
+    """One bound actor method (reference: dag/class_node.py)."""
+
+    def __init__(self, actor_handle, method_name: str,
+                 args: tuple["DAGNode", ...]):
+        for a in args:
+            if not isinstance(a, DAGNode):
+                raise TypeError(
+                    "compiled-DAG args must be DAG nodes (InputNode or "
+                    "other bound methods); constants go in actor state")
+        super().__init__(list(args))
+        self.actor_handle = actor_handle
+        self.method_name = method_name
+
+
+class MultiOutputNode(DAGNode):
+    """Fan-in terminal: execute() returns a list (reference:
+    ray.dag.MultiOutputNode)."""
+
+    def __init__(self, outputs: list[DAGNode]):
+        super().__init__(list(outputs))
+
+
+class CompiledDAGRef:
+    """Result handle for one execute() (reference: CompiledDAGRef)."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+
+    def get(self, timeout: float | None = 60.0) -> Any:
+        return self._dag._fetch(self._seq, timeout)
+
+
+class CompiledDAG:
+    def __init__(self, output_node: DAGNode):
+        from ray_tpu.core.api import _global_runtime
+        from ray_tpu.experimental.channel import Channel
+
+        self._rt = _global_runtime()
+        order: list[DAGNode] = []
+        output_node._walk(set(), order)
+        self._order = order
+        inputs = [n for n in order if isinstance(n, InputNode)]
+        if len(inputs) != 1:
+            raise ValueError("a compiled DAG needs exactly one InputNode")
+        self._multi = isinstance(output_node, MultiOutputNode)
+        self._loop_prefix = f"dag_{os.urandom(4).hex()}"
+        # one channel per EDGE (SPSC): producer node -> consumer slot
+        self._channels: list[Channel] = []
+        edge_chan: dict[tuple[int, int], Channel] = {}
+
+        def make_chan():
+            c = Channel(capacity=_CHANNEL_CAP, create=True)
+            self._channels.append(c)
+            return c
+
+        compute_nodes = [n for n in order
+                         if isinstance(n, ClassMethodNode)]
+        terminals = (output_node.upstream if self._multi
+                     else [output_node])
+        for t in terminals:
+            if not isinstance(t, ClassMethodNode):
+                raise ValueError("DAG outputs must be bound actor methods")
+        # input edges the driver writes directly
+        self._input_edges: list[Channel] = []
+        # per-node in/out channel wiring
+        node_out: dict[int, list[Channel]] = {}
+        node_ins: dict[int, list[Channel]] = {}
+        for n in compute_nodes:
+            node_ins[id(n)] = []
+            for u in n.upstream:
+                c = make_chan()
+                node_ins[id(n)].append(c)
+                if isinstance(u, InputNode):
+                    self._input_edges.append(c)
+                else:
+                    node_out.setdefault(id(u), []).append(c)
+        # terminal outputs flow to the driver through one channel each;
+        # a node feeding BOTH another node and the driver fans out below
+        self._output_chans: list[Channel] = []
+        term_ids = []
+        for t in terminals:
+            c = make_chan()
+            node_out.setdefault(id(t), []).append(c)
+            self._output_chans.append(c)
+            term_ids.append(id(t))
+        # install per-actor loops. Fan-out (one producer, many consumer
+        # channels) rides a driver-side pump when needed; the common
+        # chain/tree case is pure actor-to-actor.
+        self._pumps: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._loop_ids: list[tuple[str, str]] = []  # (actor addr, loop_id)
+        for i, n in enumerate(compute_nodes):
+            outs = node_out.get(id(n), [])
+            if len(outs) > 1:
+                mid = make_chan()
+                self._start_pump(mid, outs)
+                primary = mid
+            else:
+                primary = outs[0]
+            addr = self._rt._resolve_actor(n.actor_handle._actor_id.binary())
+            loop_id = f"{self._loop_prefix}_{i}"
+            self._rt.client.call(addr, "dag_start", {
+                "loop_id": loop_id,
+                "method": n.method_name,
+                "in_channels": [c.name for c in node_ins[id(n)]],
+                "out_channel": primary.name,
+            }, timeout=30)
+            self._loop_ids.append((addr, loop_id))
+        if len(self._input_edges) > 1:
+            # one driver write fans out to every input consumer
+            first = make_chan()
+            self._start_pump(first, self._input_edges)
+            self._write_chan = first
+        else:
+            self._write_chan = self._input_edges[0]
+        self._seq = 0
+        self._fetched = 0  # results drained from the output channels
+        self._results: dict[int, Any] = {}
+        self._fetch_lock = threading.Lock()
+
+    def _start_pump(self, src, dsts):
+        def pump():
+            while not self._stop.is_set():
+                try:
+                    v = src.get(timeout=0.5)
+                except TimeoutError:
+                    continue
+                except Exception:  # noqa: BLE001
+                    return
+                for d in dsts:
+                    try:
+                        d.put(v, timeout=60)
+                    except Exception:  # noqa: BLE001
+                        return
+
+        t = threading.Thread(target=pump, daemon=True, name="dag-pump")
+        t.start()
+        self._pumps.append(t)
+
+    # ------------------------------------------------------------ public
+
+    def execute(self, value: Any) -> CompiledDAGRef:
+        """One pipelined execution: a channel write; results stream back
+        in order (reference: CompiledDAG.execute)."""
+        self._write_chan.put(value, timeout=60)
+        ref = CompiledDAGRef(self, self._seq)
+        self._seq += 1
+        return ref
+
+    def _fetch(self, seq: int, timeout):
+        """Results arrive strictly in execution order (SPSC channels):
+        drain until `seq` has landed. Errors CONSUME their slot like any
+        result — raising without recording would desynchronize every
+        later execution's sequence number."""
+        with self._fetch_lock:
+            while seq not in self._results:
+                outs = [c.get(timeout=timeout) for c in self._output_chans]
+                err = next((o["__dag_error__"] for o in outs
+                            if isinstance(o, dict) and "__dag_error__" in o),
+                           None)
+                self._results[self._fetched] = (
+                    _DagError(err) if err is not None
+                    else (outs if self._multi else outs[0]))
+                self._fetched += 1
+            out = self._results.pop(seq)
+            if isinstance(out, _DagError):
+                raise RuntimeError(out.message)
+            return out
+
+    def teardown(self):
+        self._stop.set()
+        for addr, loop_id in self._loop_ids:
+            try:
+                self._rt.client.call(addr, "dag_stop",
+                                     {"loop_id": loop_id}, timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+        # driver-side pump threads poll at 0.5s: JOIN them before
+        # unmapping the segments (destroying under a reader is a UAF on
+        # the mmap'd base — segfault, not an exception)
+        for t in self._pumps:
+            t.join(timeout=2.0)
+        # closing marks the ring closed so any still-blocked worker
+        # reader exits cleanly before we unlink the names (their own
+        # mappings stay valid until their process detaches)
+        for c in self._channels:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        time.sleep(0.1)
+        for c in self._channels:
+            try:
+                c.destroy()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+__all__ = ["ClassMethodNode", "CompiledDAG", "CompiledDAGRef", "DAGNode",
+           "InputNode", "MultiOutputNode"]

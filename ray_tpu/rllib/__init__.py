@@ -7,8 +7,10 @@ encoders from the obs space (core/models/catalog.py:33), a Learner whose
 update is a jitted SPMD program over a jax mesh (core/learner/
 learner.py:109, torch DDP wrap replaced by GSPMD), prioritized replay
 (execution/segment_tree.py), hierarchical metrics
-(utils/metrics/metrics_logger.py), and five algorithm families: PPO,
-APPO, IMPALA, DQN (+PER), SAC.
+(utils/metrics/metrics_logger.py), offline RL (offline_data.py:22 —
+recording, BC, MARWIL), multi-agent (multi_rl_module.py:49 +
+MultiAgentEnv), and seven algorithm families: PPO, APPO, IMPALA,
+DQN (+PER), SAC, BC, MARWIL.
 """
 
 from ray_tpu.rllib.appo import APPO, APPOConfig
@@ -26,6 +28,19 @@ from ray_tpu.rllib.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace
 from ray_tpu.rllib.learner import PPOLearner, PPOLearnerConfig, compute_gae
 from ray_tpu.rllib.metrics import MetricsLogger
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentEnv,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+    MultiRLModule,
+)
+from ray_tpu.rllib.offline import (
+    BC,
+    BCConfig,
+    MARWILConfig,
+    load_offline_dataset,
+    record_experiences,
+)
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.replay import PrioritizedReplayBuffer, SumTree
 from ray_tpu.rllib.sac import SAC, SACConfig
@@ -33,6 +48,9 @@ from ray_tpu.rllib.sac import SAC, SACConfig
 __all__ = [
     "APPO",
     "APPOConfig",
+    "BC",
+    "BCConfig",
+    "MARWILConfig",
     "Catalog",
     "ConnectorPipeline",
     "ConnectorV2",
@@ -45,6 +63,10 @@ __all__ = [
     "IMPALA",
     "IMPALAConfig",
     "MetricsLogger",
+    "MultiAgentEnv",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
+    "MultiRLModule",
     "NormalizeImage",
     "PPO",
     "PPOConfig",
@@ -57,5 +79,7 @@ __all__ = [
     "SingleAgentEnvRunner",
     "SumTree",
     "compute_gae",
+    "load_offline_dataset",
+    "record_experiences",
     "vtrace",
 ]

@@ -1,0 +1,62 @@
+"""Checkpointable — uniform component-state save/restore for algorithms.
+
+Reference parity: rllib/utils/checkpoints.py Checkpointable (get_state /
+set_state / save_to_path / restore_from_path as a uniform component
+tree). Algorithms expose their state as a nested dict of named
+components; the mixin persists it with cloudpickle (jax pytrees of
+numpy-converted leaves are plain data).
+"""
+
+from __future__ import annotations
+
+import os
+
+import cloudpickle
+
+
+class Checkpointable:
+    """Mixin: subclasses define STATE_COMPONENTS, a tuple of attribute
+    names whose values form the component tree. jax arrays are
+    host-converted on save so checkpoints are device-independent."""
+
+    STATE_COMPONENTS: tuple[str, ...] = ()
+
+    def get_state(self) -> dict:
+        import jax
+        import numpy as np
+
+        def host(v):
+            try:
+                return jax.tree.map(np.asarray, v)
+            except Exception:  # noqa: BLE001
+                return v
+
+        return {name: host(getattr(self, name))
+                for name in self.STATE_COMPONENTS}
+
+    def set_state(self, state: dict):
+        import jax
+        import jax.numpy as jnp
+
+        for name, value in state.items():
+            if name not in self.STATE_COMPONENTS:
+                continue
+            try:
+                value = jax.tree.map(jnp.asarray, value)
+            except Exception:  # noqa: BLE001
+                pass
+            setattr(self, name, value)
+
+    def save_to_path(self, path: str) -> str:
+        os.makedirs(path, exist_ok=True)
+        out = os.path.join(path, "state.pkl")
+        with open(out, "wb") as f:
+            cloudpickle.dump(
+                {"class": type(self).__name__, "state": self.get_state()}, f)
+        return path
+
+    def restore_from_path(self, path: str):
+        with open(os.path.join(path, "state.pkl"), "rb") as f:
+            payload = cloudpickle.load(f)
+        self.set_state(payload["state"])
+        return self

@@ -106,12 +106,18 @@ class DQNConfig:
         return DQN(self)
 
 
-class DQN:
+from ray_tpu.rllib.checkpointable import Checkpointable
+
+
+class DQN(Checkpointable):
     """Epsilon-greedy sampling rides the PPO env-runner machinery: the
     runner samples with a stochastic policy head; DQN overrides sampled
     actions toward greedy as epsilon decays by syncing a temperature-less
     Q-head (the categorical over Q-logits acts as exploration — with
     epsilon mixed in on the learner-side weight sync)."""
+
+    STATE_COMPONENTS = ("params", "target_params", "opt_state",
+                        "_env_steps", "_updates", "_iteration")
 
     def __init__(self, config: DQNConfig):
         self.config = config

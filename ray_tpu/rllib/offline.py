@@ -1,0 +1,218 @@
+"""Offline RL — experience recording, offline datasets, BC and MARWIL.
+
+Reference parity: rllib/offline/offline_data.py:22 (OfflineData wraps a
+ray.data dataset of experiences feeding learners),
+rllib/algorithms/bc (behavior cloning from logged episodes) and
+rllib/algorithms/marwil (advantage-weighted BC). TPU shape: experiences
+are recorded by env runners into jsonl/parquet via ray_tpu.data; the
+offline learner is the same jitted SPMD update machinery, fed by
+dataset iter_batches instead of live sampling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import models
+
+
+def record_experiences(env: str, num_episodes: int, out_dir: str,
+                       seed: int = 0, hidden=(64, 64), params=None,
+                       fmt: str = "jsonl"):
+    """Roll out a (random or given) policy and persist experiences as a
+    ray_tpu.data-readable dataset (reference: offline recording via
+    EnvRunner output_config -> ray.data write)."""
+    from ray_tpu import data as rd
+    from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
+
+    runner = SingleAgentEnvRunner(env=env, num_envs=4,
+                                  rollout_fragment_length=128, seed=seed,
+                                  hidden=hidden)
+    if params is not None:
+        runner.set_weights(params)
+    rows = []
+    episodes_done = 0
+    while episodes_done < num_episodes:
+        s = runner.sample()
+        T, N = s["rewards"].shape
+        for t in range(T):
+            for n in range(N):
+                if s["reset_mask"][t, n]:
+                    continue
+                rows.append({
+                    "obs": [float(x) for x in s["obs"][t, n].reshape(-1)],
+                    "action": int(s["actions"][t, n]),
+                    "reward": float(s["rewards"][t, n]),
+                    "done": bool(s["dones"][t, n]),
+                    "logp": float(s["logp"][t, n]),
+                })
+        episodes_done += s["num_episodes"]
+    ds = rd.from_items(rows, parallelism=8)
+    if fmt == "parquet":
+        return ds.write_parquet(out_dir)
+    return ds.write_jsonl(out_dir)
+
+
+def load_offline_dataset(path: str):
+    """OfflineData role (offline_data.py:22): a Dataset of experience
+    rows for offline training."""
+    from ray_tpu import data as rd
+
+    try:
+        return rd.read_parquet(path)
+    except Exception:  # noqa: BLE001
+        return rd.read_json(path)
+
+
+@dataclasses.dataclass
+class BCConfig:
+    """Reference: rllib/algorithms/bc/bc.py — supervised action
+    cloning on logged states."""
+
+    input_path: str = ""
+    lr: float = 1e-3
+    train_batch_size: int = 256
+    hidden: tuple = (64, 64)
+    # MARWIL generalization (marwil.py): beta > 0 weights the cloning
+    # loss by exp(beta * advantage) where advantage is the discounted
+    # return minus a learned value baseline; beta = 0 is plain BC.
+    beta: float = 0.0
+    gamma: float = 0.99
+    vf_coeff: float = 1.0
+    seed: int = 0
+
+    def offline_data(self, input_path: str) -> "BCConfig":
+        self.input_path = input_path
+        return self
+
+    def training(self, **kw) -> "BCConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "BC":
+        return BC(self)
+
+
+@dataclasses.dataclass
+class MARWILConfig(BCConfig):
+    beta: float = 1.0
+
+    def build(self) -> "BC":
+        return BC(self)
+
+
+class BC:
+    """Behavior cloning / MARWIL driver: one jitted supervised update
+    per minibatch over the offline dataset."""
+
+    def __init__(self, config: BCConfig):
+        self.config = config
+        rows = load_offline_dataset(config.input_path).take_all()
+        if not rows:
+            raise ValueError(f"no offline rows at {config.input_path!r}")
+        obs = np.asarray([r["obs"] for r in rows], np.float32)
+        acts = np.asarray([r["action"] for r in rows], np.int64)
+        rews = np.asarray([r["reward"] for r in rows], np.float32)
+        dones = np.asarray([r["done"] for r in rows], np.bool_)
+        # Monte-Carlo returns per (recorded) trajectory for MARWIL's
+        # advantage weighting; episode boundaries come from `done`
+        returns = np.zeros(len(rows), np.float32)
+        g = 0.0
+        for i in range(len(rows) - 1, -1, -1):
+            g = 0.0 if dones[i] else g
+            g = rews[i] + config.gamma * g
+            returns[i] = g
+        self._data = {"obs": obs, "actions": acts, "returns": returns}
+        self.obs_dim = obs.shape[1]
+        self.n_actions = int(acts.max()) + 1
+
+        self.params = models.init_mlp_policy(
+            jax.random.PRNGKey(config.seed), self.obs_dim, self.n_actions,
+            config.hidden)
+        self.tx = optax.adam(config.lr)
+        self.opt_state = self.tx.init(self.params)
+        cfg = config
+
+        def loss_fn(params, batch):
+            logits, value = models.forward(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=1)[:, 0]
+            if cfg.beta > 0.0:
+                adv = batch["returns"] - value
+                w = jnp.exp(cfg.beta * jax.lax.stop_gradient(
+                    adv / (jnp.abs(adv).mean() + 1e-8)))
+                bc = -jnp.mean(w * logp)
+                vf = jnp.mean(adv ** 2)
+                return bc + cfg.vf_coeff * vf, (bc, vf)
+            return -jnp.mean(logp), (-jnp.mean(logp), 0.0)
+
+        def update(params, opt_state, batch):
+            (total, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, total
+
+        self._update = jax.jit(update, donate_argnums=(0, 1))
+        self._rng = np.random.RandomState(config.seed)
+        self._iteration = 0
+
+    def train(self) -> dict:
+        cfg = self.config
+        n = len(self._data["actions"])
+        t0 = time.perf_counter()
+        losses = []
+        perm = self._rng.permutation(n)
+        mb = min(cfg.train_batch_size, n)
+        for i in range(max(1, n // mb)):
+            idx = perm[i * mb:(i + 1) * mb]
+            batch = {k: jnp.asarray(v[idx])
+                     for k, v in self._data.items()}
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state, batch)
+            losses.append(float(loss))
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "learner/loss": float(np.mean(losses)),
+            "num_samples": n,
+            "time_s": time.perf_counter() - t0,
+        }
+
+    def evaluate(self, env: str, num_episodes: int = 20) -> dict:
+        """Greedy rollout of the cloned policy (reference: BC eval via
+        evaluation env runners)."""
+        import gymnasium as gym
+
+        from ray_tpu.rllib import envs as _envs
+
+        _envs.register_envs()
+        e = gym.make(env)
+        fwd = jax.jit(models.forward)
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = e.reset(seed=1000 + ep)
+            total, done = 0.0, False
+            while not done:
+                logits, _ = fwd(self.params,
+                                np.asarray(obs, np.float32).reshape(1, -1))
+                action = int(np.argmax(np.asarray(logits)[0]))
+                obs, r, term, trunc, _ = e.step(action)
+                total += float(r)
+                done = term or trunc
+            returns.append(total)
+        e.close()
+        return {"episode_return_mean": float(np.mean(returns)),
+                "num_episodes": num_episodes}
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)

@@ -98,8 +98,24 @@ class PPOConfig:
         return PPO(self)
 
 
-class PPO:
+from ray_tpu.rllib.checkpointable import Checkpointable
+
+
+class PPO(Checkpointable):
     """Algorithm driver (reference: Algorithm.step → PPO.training_step)."""
+
+    STATE_COMPONENTS = ("_iteration", "_env_steps_total")
+
+    def get_state(self) -> dict:
+        state = super().get_state()
+        state["learner"] = {"params": self.learner.get_weights()}
+        return state
+
+    def set_state(self, state: dict):
+        super().set_state(state)
+        if "learner" in state:
+            self.learner.set_weights(state["learner"]["params"])
+            self.env_runner_group.sync_weights(self.learner.get_weights())
 
     def __init__(self, config: PPOConfig):
         self.config = config

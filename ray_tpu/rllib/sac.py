@@ -100,7 +100,13 @@ class SACConfig:
         return SAC(self)
 
 
-class SAC:
+from ray_tpu.rllib.checkpointable import Checkpointable
+
+
+class SAC(Checkpointable):
+    STATE_COMPONENTS = ("params", "target_q", "log_alpha",
+                        "_env_steps", "_iteration")
+
     def __init__(self, config: SACConfig):
         import gymnasium as gym
 

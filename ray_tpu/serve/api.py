@@ -429,7 +429,7 @@ class ProxyActor:
     ingress. Handle objects are cached per app (they refresh their
     replica sets themselves)."""
 
-    def __init__(self, port: int):
+    def __init__(self, port: int, host: str | None = None):
         import json
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -462,8 +462,15 @@ class ProxyActor:
             def log_message(self, *a):  # quiet
                 pass
 
+        # Bind scope: loopback by default. Cross-host ingress requires the
+        # operator to have OPTED IN to routable networking by setting
+        # RAY_TPU_NODE_IP (then we bind that advertised interface), or to
+        # pass `host` explicitly — the ingress is unauthenticated, like
+        # the reference's default HTTP proxy, so exposure is a deliberate
+        # deployment decision.
         ip = node_ip()
-        bind_host = "" if ip != "127.0.0.1" else "127.0.0.1"
+        bind_host = host if host is not None else \
+            (ip if ip != "127.0.0.1" else "127.0.0.1")
         self._server = ThreadingHTTPServer((bind_host, port), Handler)
         self._server.daemon_threads = True
         self.address = f"{ip}:{self._server.server_address[1]}"
@@ -489,13 +496,13 @@ class ProxyActor:
         return True
 
 
-def start_proxy(port: int = 8000) -> str:
+def start_proxy(port: int = 8000, host: str | None = None) -> str:
     """Start (or find) the ingress proxy actor; returns 'ip:port'."""
     import ray_tpu
 
     cls = ray_tpu.remote(num_cpus=0)(ProxyActor)
     proxy = cls.options(name=_PROXY_NAME, get_if_exists=True,
-                        max_concurrency=4).remote(port)
+                        max_concurrency=4).remote(port, host)
     return ray_tpu.get(proxy.get_address.remote(), timeout=60)
 
 

@@ -169,9 +169,9 @@ class JaxTrainer:
         while True:
             wg = None
             try:
-                wg = self._start_worker_group(name, exp_dir, resume,
-                                              resize_to)
-                resize_to = None
+                target, resize_to = resize_to, None  # one-shot: a FAILED
+                # resized start must not retry the stale target forever
+                wg = self._start_worker_group(name, exp_dir, resume, target)
                 metrics, ckpt = self._result_loop(wg, manager, history)
                 return Result(metrics=metrics, checkpoint=ckpt or
                               manager.latest(), path=exp_dir,

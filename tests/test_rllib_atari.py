@@ -263,3 +263,33 @@ def test_sac_improves_on_pendulum():
     assert np.mean(late[-20:]) > np.mean(early[:20]) + 300, \
         (np.mean(early[:20]), np.mean(late[-20:]))
     assert 0 < r["alpha"] < 1.0  # temperature auto-tuned down
+
+
+# ---------------------------------------------------------------- checkpointable
+
+def test_checkpointable_save_restore(tmp_path):
+    """Uniform component-tree save/restore (reference:
+    rllib/utils/checkpoints.py Checkpointable)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from ray_tpu.rllib.ppo import PPOConfig
+
+    cfg = (PPOConfig().environment("CartPole-v1")
+           .env_runners(num_env_runners=0, num_envs_per_env_runner=4,
+                        rollout_fragment_length=16))
+    a = cfg.build()
+    a.train()
+    a.save_to_path(str(tmp_path / "ck"))
+    b = (PPOConfig().environment("CartPole-v1")
+         .env_runners(num_env_runners=0, num_envs_per_env_runner=4,
+                      rollout_fragment_length=16)).build()
+    b.restore_from_path(str(tmp_path / "ck"))
+    assert b._iteration == a._iteration == 1
+    wa = a.learner.get_weights()
+    wb = b.learner.get_weights()
+    la, lb = jax.tree_util.tree_leaves(wa), jax.tree_util.tree_leaves(wb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(x, y)
+    a.stop()
+    b.stop()
