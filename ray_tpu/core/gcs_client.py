@@ -1,0 +1,121 @@
+"""GcsClient — the typed accessor suite over the head's RPC surface.
+
+Reference parity: the GCS client accessors
+(src/ray/gcs/gcs_client/accessor.h:43-583 — NodeInfoAccessor,
+ActorInfoAccessor, InternalKVAccessor, PlacementGroupInfoAccessor,
+TaskInfoAccessor) collapsed into one typed Python client: every method
+wraps one head RPC with typed arguments/results instead of raw
+`RpcClient.call(addr, method, dict)` plumbing.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class GcsClient:
+    def __init__(self, address: str | None = None, timeout: float = 30.0):
+        from ray_tpu.core.rpc import RpcClient
+
+        if address is None:
+            from ray_tpu.core import api as _api
+
+            rt = _api._runtime
+            if rt is None or not hasattr(rt, "head_address"):
+                raise RuntimeError(
+                    "GcsClient needs ray_tpu.init() or an explicit address")
+            address = rt.head_address
+        self.address = address
+        self.timeout = timeout
+        self._rpc = RpcClient.shared()
+
+    def _call(self, method: str, msg: dict | None = None,
+              frames: list = ()) -> Any:
+        return self._rpc.call(self.address, method, msg or {},
+                              frames=frames, timeout=self.timeout)
+
+    # ------------------------------------------------------- NodeInfoAccessor
+
+    def get_all_node_info(self) -> list[dict]:
+        """ref: accessor.h NodeInfoAccessor::GetAll."""
+        return [
+            {"node_id": n["node_id"].hex(), "address": n["address"],
+             "alive": n["alive"], "resources": n["resources"],
+             "available": n["available"], "labels": n["labels"]}
+            for n in self._call("cluster_view")["nodes"]
+        ]
+
+    def get_node_info(self, node_id: str) -> dict | None:
+        for n in self.get_all_node_info():
+            if n["node_id"].startswith(node_id):
+                return n
+        return None
+
+    def get_cluster_resources(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+        for n in self.get_all_node_info():
+            if n["alive"]:
+                for r, q in n["resources"].items():
+                    out[r] = out.get(r, 0.0) + q
+        return out
+
+    # ------------------------------------------------------ ActorInfoAccessor
+
+    def get_all_actor_info(self) -> list[dict]:
+        """ref: accessor.h ActorInfoAccessor::GetAll."""
+        return self._call("list_actors")["actors"]
+
+    def get_actor_info(self, actor_id: bytes) -> dict:
+        """ref: ActorInfoAccessor::Get (non-blocking state lookup)."""
+        return self._call("get_actor", {"actor_id": actor_id,
+                                        "wait": False})
+
+    def get_named_actor_info(self, name: str,
+                             namespace: str = "default") -> dict:
+        return self._call("get_named_actor",
+                          {"name": name, "namespace": namespace})
+
+    # ------------------------------------------------------ InternalKVAccessor
+
+    def internal_kv_put(self, key: str, value: bytes, *,
+                        namespace: str = "kv",
+                        overwrite: bool = True) -> bool:
+        """ref: accessor.h InternalKVAccessor::Put."""
+        r = self._call("kv_put", {"ns": namespace, "key": key,
+                                  "overwrite": overwrite},
+                       frames=[value])
+        return bool(r.get("added"))
+
+    def internal_kv_get(self, key: str, *,
+                        namespace: str = "kv") -> bytes | None:
+        value, frames = self._rpc.call_frames(
+            self.address, "kv_get", {"ns": namespace, "key": key},
+            timeout=self.timeout)
+        if not value.get("found"):
+            return None
+        return frames[0] if frames else b""
+
+    def internal_kv_del(self, key: str, *, namespace: str = "kv") -> bool:
+        return bool(self._call("kv_del", {"ns": namespace,
+                                          "key": key}).get("deleted"))
+
+    def internal_kv_keys(self, prefix: str = "", *,
+                         namespace: str = "kv") -> list[str]:
+        keys = self._call("kv_keys", {"ns": namespace,
+                                      "prefix": prefix})["keys"]
+        return list(keys)
+
+    # ------------------------------------------- PlacementGroupInfoAccessor
+
+    def get_all_placement_group_info(self) -> list[dict]:
+        """ref: accessor.h PlacementGroupInfoAccessor::GetAll."""
+        return self._call("pg_table", {})["groups"]
+
+    def get_placement_group_info(self, pg_id: bytes) -> dict:
+        return self._call("pg_table", {"pg_id": pg_id})
+
+    # ------------------------------------------------------- TaskInfoAccessor
+
+    def get_task_events(self, limit: int = 1000) -> list[dict]:
+        """ref: TaskInfoAccessor over the GcsTaskManager event store."""
+        return self._call("list_tasks", {"limit": limit})["tasks"]

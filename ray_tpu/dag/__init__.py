@@ -186,6 +186,10 @@ class CompiledDAG:
         self._seq = 0
         self._fetched = 0  # results drained from the output channels
         self._results: dict[int, Any] = {}
+        # values already drained from SOME output channels of the row
+        # currently being assembled — survives a get() timeout so a
+        # partially-drained multi-output row is resumed, never lost
+        self._partial: list = []
         self._fetch_lock = threading.Lock()
 
     def _start_pump(self, src, dsts):
@@ -224,7 +228,13 @@ class CompiledDAG:
         later execution's sequence number."""
         with self._fetch_lock:
             while seq not in self._results:
-                outs = [c.get(timeout=timeout) for c in self._output_chans]
+                # drain channel-by-channel into the resumable partial row:
+                # a timeout mid-row must not discard already-popped values
+                # (SPSC pops are destructive)
+                while len(self._partial) < len(self._output_chans):
+                    c = self._output_chans[len(self._partial)]
+                    self._partial.append(c.get(timeout=timeout))
+                outs, self._partial = self._partial, []
                 err = next((o["__dag_error__"] for o in outs
                             if isinstance(o, dict) and "__dag_error__" in o),
                            None)

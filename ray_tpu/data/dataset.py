@@ -158,9 +158,13 @@ class Dataset:
 
     # ------------------------------------------------------------ execution
 
-    def _execute(self, max_in_flight: int | None = None) -> Iterator:
-        """Stream result block refs in input order with bounded in-flight
-        tasks (backpressure — streaming_executor.py:48)."""
+    def _execute(self, max_in_flight: int | None = None,
+                 memory_budget: int | None = None) -> Iterator:
+        """Stream result block refs in input order under the resource-
+        managed streaming executor: a concurrency cap on in-flight tasks
+        plus a MEMORY budget on produced-but-unconsumed block bytes
+        (reference: streaming_executor.py:48 + resource_manager.py +
+        backpressure_policy.py:11)."""
         import ray_tpu
 
         actor_stage = getattr(self, "_actor_stage", None)
@@ -172,16 +176,20 @@ class Dataset:
             2, int(ray_tpu.cluster_resources().get("CPU", 4)))
 
         if actor_stage is None:
+            from ray_tpu.data.executor import (
+                StreamingExecutor,
+                default_policies,
+            )
+
             @ray_tpu.remote(num_cpus=1)
             def _apply_block(block):
                 return fused(block)
 
-            pending: list = []
-            for ref in self._block_refs:
-                pending.append(_apply_block.remote(ref))
-                if len(pending) >= limit:
-                    yield pending.pop(0)
-            yield from pending
+            executor = StreamingExecutor(default_policies(
+                max_in_flight=limit, memory_budget=memory_budget))
+            self._last_executor = executor  # observability / tests
+            yield from executor.run(list(self._block_refs),
+                                    lambda ref: _apply_block.remote(ref))
             return
 
         apply_fn, num_actors = actor_stage

@@ -1,0 +1,135 @@
+"""Streaming executor — resource-managed, backpressured block execution.
+
+Reference parity: the StreamingExecutor + ResourceManager +
+backpressure policies (python/ray/data/_internal/execution/
+streaming_executor.py:48, execution/resource_manager.py,
+backpressure_policy.py:11 ConcurrencyCapBackpressurePolicy). The
+executor admits new block tasks only while every policy allows it:
+a concurrency cap bounds in-flight tasks, and a memory budget bounds
+the BYTES of produced-but-unconsumed blocks (sizes read from the
+owner's object metadata after task_done) so ingestion cannot crowd
+training out of host RAM.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+
+class ExecutionStats:
+    __slots__ = ("in_flight", "buffered_bytes", "submitted", "yielded",
+                 "backpressure_waits", "peak_buffered_bytes")
+
+    def __init__(self):
+        self.in_flight = 0
+        self.buffered_bytes = 0
+        self.submitted = 0
+        self.yielded = 0
+        self.backpressure_waits = 0
+        self.peak_buffered_bytes = 0
+
+
+class BackpressurePolicy:
+    """Admission policy: may a new block task be submitted now?
+    (reference: backpressure_policy.py:11)."""
+
+    def can_add_input(self, stats: ExecutionStats) -> bool:
+        raise NotImplementedError
+
+
+class ConcurrencyCapBackpressurePolicy(BackpressurePolicy):
+    def __init__(self, cap: int):
+        self.cap = max(1, cap)
+
+    def can_add_input(self, stats: ExecutionStats) -> bool:
+        return stats.in_flight < self.cap
+
+
+class MemoryBudgetBackpressurePolicy(BackpressurePolicy):
+    """Bounds bytes of completed-but-unconsumed output blocks (the
+    ResourceManager's object-store budget role). Always admits when
+    nothing is in flight so execution cannot deadlock on one oversized
+    block."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget = max(1, budget_bytes)
+
+    def can_add_input(self, stats: ExecutionStats) -> bool:
+        return (stats.in_flight == 0
+                or stats.buffered_bytes < self.budget)
+
+
+def default_policies(max_in_flight: int | None = None,
+                     memory_budget: int | None = None):
+    import ray_tpu
+    from ray_tpu.core import config as cfg
+
+    cap = max_in_flight or max(
+        2, int(ray_tpu.cluster_resources().get("CPU", 4)))
+    budget = memory_budget or cfg.get("OBJECT_STORE_BYTES") // 4
+    return [ConcurrencyCapBackpressurePolicy(cap),
+            MemoryBudgetBackpressurePolicy(budget)]
+
+
+def _ref_size(ref) -> int:
+    """Serialized size of a completed driver-owned output (0 while
+    pending/unknown) from the ownership table."""
+    from ray_tpu.core.api import _global_runtime
+
+    rt = _global_runtime()
+    owned = getattr(rt, "_owned", None)
+    if owned is None:
+        # local-mode runtime has no ownership table: sizes unknown, the
+        # memory policy degrades to the pure concurrency cap
+        return 0
+    st = owned.get(ref.id.binary())
+    if st is not None and st.event.is_set():
+        return int(st.size or 0)
+    return 0
+
+
+class StreamingExecutor:
+    """Order-preserving streamed map of `submit(block_ref) -> ref` over
+    input refs, gated by the policies. The consumer's iteration drives
+    admission: blocks buffered ahead of the consumer count against the
+    memory budget until yielded."""
+
+    def __init__(self, policies=None):
+        self.policies = policies
+        self.stats = ExecutionStats()
+
+    def run(self, input_refs: list, submit) -> Iterator:
+        import time as _t
+
+        import ray_tpu
+
+        policies = self.policies or default_policies()
+        stats = self.stats
+        window: list = []  # submitted, not yet yielded (input order)
+        i = 0
+        n = len(input_refs)
+        while i < n or window:
+            # account completed-but-unconsumed bytes
+            stats.buffered_bytes = sum(_ref_size(r) for r in window)
+            stats.peak_buffered_bytes = max(stats.peak_buffered_bytes,
+                                            stats.buffered_bytes)
+            done = [r for r in window if _ref_size(r) > 0]
+            stats.in_flight = len(window) - len(done)
+            if i < n:
+                if all(p.can_add_input(stats) for p in policies):
+                    window.append(submit(input_refs[i]))
+                    stats.submitted += 1
+                    i += 1
+                    continue
+                stats.backpressure_waits += 1  # admission deferred
+            if window:
+                head = window[0]
+                ready, _ = ray_tpu.wait([head], num_returns=1, timeout=0.5)
+                if ready:
+                    window.pop(0)
+                    stats.yielded += 1
+                    yield head
+                    continue
+                _t.sleep(0.01)
+            else:
+                _t.sleep(0.005)

@@ -1,5 +1,20 @@
-"""Model zoo for the TPU-native framework (pure-JAX, mesh-shardable)."""
+"""Model zoo for the TPU-native framework (pure-JAX, mesh-shardable):
+GPT-2, Llama-family (RoPE/RMSNorm/SwiGLU/GQA), MoE layer."""
 
-from ray_tpu.models.gpt2 import GPT2Config, gpt2_partition_rules, init_gpt2, gpt2_forward
+from ray_tpu.models.gpt2 import (
+    GPT2Config,
+    gpt2_forward,
+    gpt2_partition_rules,
+    init_gpt2,
+)
+from ray_tpu.models.llama import (
+    LlamaConfig,
+    init_llama,
+    llama_forward,
+    llama_loss,
+    llama_partition_rules,
+)
 
-__all__ = ["GPT2Config", "gpt2_partition_rules", "init_gpt2", "gpt2_forward"]
+__all__ = ["GPT2Config", "LlamaConfig", "gpt2_forward",
+           "gpt2_partition_rules", "init_gpt2", "init_llama",
+           "llama_forward", "llama_loss", "llama_partition_rules"]

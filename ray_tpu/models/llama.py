@@ -1,0 +1,194 @@
+"""Llama-family transformer — RMSNorm, RoPE, SwiGLU, grouped-query attn.
+
+Second flagship model family beside GPT-2 (SURVEY.md §2.4 model breadth;
+the reference trains Llama-class models through TorchTrainer — here the
+architecture is built TPU-first like models/gpt2.py): scan-stacked
+blocks, Megatron-sharded partition rules over the canonical mesh axes,
+bf16 activations with f32 norms, flash attention via ops.attention, and
+GQA (n_kv_heads < n_heads) with K/V head replication at attention time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import causal_attention
+from ray_tpu.parallel.sharding import PartitionRules, constrain
+
+Params = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    n_layer: int = 8
+    n_head: int = 8
+    n_kv_head: int = 4  # grouped-query attention
+    n_embd: int = 512
+    intermediate: int = 1408  # SwiGLU hidden (~8/3 * n_embd, 128-aligned)
+    block_size: int = 1024
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    @property
+    def padded_vocab(self) -> int:
+        return ((self.vocab_size + 127) // 128) * 128
+
+    @staticmethod
+    def tiny() -> "LlamaConfig":
+        return LlamaConfig(vocab_size=512, n_layer=2, n_head=4, n_kv_head=2,
+                           n_embd=128, intermediate=384, block_size=128,
+                           dtype=jnp.float32, remat=False)
+
+    @staticmethod
+    def small() -> "LlamaConfig":
+        """~110M-param config comparable to GPT-2-small for benching."""
+        return LlamaConfig(vocab_size=32000, n_layer=12, n_head=12,
+                           n_kv_head=4, n_embd=768, intermediate=2048,
+                           block_size=1024)
+
+
+def llama_partition_rules() -> PartitionRules:
+    """Megatron layout over the canonical axes: attention/MLP input
+    projections sharded on the output dim over 'tensor', output
+    projections on the input dim; embeddings vocab-sharded; everything
+    fsdp-sharded on the other dim."""
+    from jax.sharding import PartitionSpec as P
+
+    # block params are scan-STACKED: leading dim is the layer axis and
+    # must stay unsharded (None), like gpt2_partition_rules
+    return PartitionRules([
+        (r"blocks/(wq|wk|wv)$", P(None, "fsdp", "tensor")),
+        (r"blocks/wo$", P(None, "tensor", "fsdp")),
+        (r"blocks/(w_gate|w_up)$", P(None, "fsdp", "tensor")),
+        (r"blocks/w_down$", P(None, "tensor", "fsdp")),
+        (r"blocks/(ln_attn|ln_mlp)$", P()),
+        (r"wte$", P("tensor", "fsdp")),
+        (r"lnf$", P()),
+        (r".*", P()),
+    ])
+
+
+def init_llama(key: jax.Array, cfg: LlamaConfig) -> Params:
+    L, E, V = cfg.n_layer, cfg.n_embd, cfg.padded_vocab
+    hd = cfg.head_dim
+    kv_dim = cfg.n_kv_head * hd
+    std = 0.02
+    out_std = std / math.sqrt(2 * L)
+    ks = jax.random.split(key, 8)
+
+    def stack(base, shape, scale):
+        keys = jax.random.split(base, L)
+        return jnp.stack([jax.random.normal(keys[i], shape, jnp.float32)
+                          * scale for i in range(L)])
+
+    return {
+        "wte": jax.random.normal(ks[0], (V, E), jnp.float32) * std,
+        "blocks": {
+            "ln_attn": jnp.ones((L, E)),
+            "wq": stack(ks[1], (E, E), std),
+            "wk": stack(ks[2], (E, kv_dim), std),
+            "wv": stack(ks[3], (E, kv_dim), std),
+            "wo": stack(ks[4], (E, E), out_std),
+            "ln_mlp": jnp.ones((L, E)),
+            "w_gate": stack(ks[5], (E, cfg.intermediate), std),
+            "w_up": stack(ks[6], (E, cfg.intermediate), std),
+            "w_down": stack(ks[7], (cfg.intermediate, E), out_std),
+        },
+        "lnf": jnp.ones((E,)),
+    }
+
+
+def _rmsnorm(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms * scale).astype(x.dtype)
+
+
+def _rope(x, theta: float):
+    """Rotary embedding over the last dim of (B, T, H, D)."""
+    B, T, H, D = x.shape
+    half = D // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = jnp.arange(T, dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+def _block(x, p, cfg: LlamaConfig):
+    B, T, E = x.shape
+    dt = cfg.dtype
+    hd = cfg.head_dim
+
+    h = _rmsnorm(x, p["ln_attn"], cfg.rms_eps)
+    q = (h @ p["wq"].astype(dt)).reshape(B, T, cfg.n_head, hd)
+    k = (h @ p["wk"].astype(dt)).reshape(B, T, cfg.n_kv_head, hd)
+    v = (h @ p["wv"].astype(dt)).reshape(B, T, cfg.n_kv_head, hd)
+    q = _rope(q, cfg.rope_theta)
+    k = _rope(k, cfg.rope_theta)
+    # GQA: replicate K/V heads up to n_head (reference semantics of
+    # repeat_kv; XLA turns the broadcast into reuse, no materialized copy
+    # survives fusion)
+    rep = cfg.n_head // cfg.n_kv_head
+    k = jnp.repeat(k, rep, axis=2)
+    v = jnp.repeat(v, rep, axis=2)
+    att = causal_attention(q, k, v).reshape(B, T, E)
+    att = att @ p["wo"].astype(dt)
+    x = x + constrain(att, ("data", "fsdp"), None, None)
+
+    h = _rmsnorm(x, p["ln_mlp"], cfg.rms_eps)
+    gate = h @ p["w_gate"].astype(dt)
+    up = h @ p["w_up"].astype(dt)
+    gate = constrain(gate, ("data", "fsdp"), None, "tensor")
+    h = (jax.nn.silu(gate) * up) @ p["w_down"].astype(dt)
+    x = x + constrain(h, ("data", "fsdp"), None, None)
+    return x
+
+
+def llama_forward(params: Params, tokens: jax.Array,
+                  cfg: LlamaConfig) -> jax.Array:
+    """tokens (B, T) int32 -> logits (B, T, padded_vocab) float32."""
+    B, T = tokens.shape
+    dt = cfg.dtype
+    wte = constrain(params["wte"].astype(dt), None, None)
+    x = wte[tokens]
+    x = constrain(x, ("data", "fsdp"), None, None)
+
+    block = _block
+    if cfg.remat:
+        block = jax.checkpoint(_block, static_argnums=(2,))
+
+    def body(carry, layer_params):
+        return block(carry, layer_params, cfg), None
+
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    x = _rmsnorm(x, params["lnf"], cfg.rms_eps)
+    logits = x @ params["wte"].astype(dt).T
+    logits = constrain(logits, ("data", "fsdp"), None, "tensor")
+    return logits.astype(jnp.float32)
+
+
+def llama_loss(params: Params, batch: dict, cfg: LlamaConfig) -> jax.Array:
+    logits = llama_forward(params, batch["tokens"], cfg)
+    V = cfg.padded_vocab
+    mask = jnp.arange(V) < cfg.vocab_size
+    logits = jnp.where(mask, logits, -1e9)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, batch["targets"][..., None],
+                             axis=-1)[..., 0]
+    return -jnp.mean(ll)

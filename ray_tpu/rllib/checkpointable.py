@@ -27,7 +27,10 @@ class Checkpointable:
 
         def host(v):
             try:
-                return jax.tree.map(np.asarray, v)
+                # device arrays -> host numpy; plain scalars stay scalars
+                return jax.tree.map(
+                    lambda x: np.asarray(x)
+                    if isinstance(x, jax.Array) else x, v)
             except Exception:  # noqa: BLE001
                 return v
 
@@ -37,12 +40,17 @@ class Checkpointable:
     def set_state(self, state: dict):
         import jax
         import jax.numpy as jnp
+        import numpy as np
 
         for name, value in state.items():
             if name not in self.STATE_COMPONENTS:
                 continue
             try:
-                value = jax.tree.map(jnp.asarray, value)
+                # only ARRAY leaves go back to device; scalar bookkeeping
+                # (iteration counters) must stay plain python ints
+                value = jax.tree.map(
+                    lambda v: jnp.asarray(v)
+                    if isinstance(v, np.ndarray) else v, value)
             except Exception:  # noqa: BLE001
                 pass
             setattr(self, name, value)

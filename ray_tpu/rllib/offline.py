@@ -41,17 +41,26 @@ def record_experiences(env: str, num_episodes: int, out_dir: str,
     while episodes_done < num_episodes:
         s = runner.sample()
         T, N = s["rewards"].shape
-        for t in range(T):
-            for n in range(N):
+        # ENV-MAJOR row order: each env's steps are contiguous and
+        # time-ordered so downstream return scans chain within one
+        # trajectory only. The last row of each env's fragment segment is
+        # marked done (truncation) so a return scan never crosses into a
+        # different env's rows.
+        for n in range(N):
+            seg_rows = []
+            for t in range(T):
                 if s["reset_mask"][t, n]:
                     continue
-                rows.append({
+                seg_rows.append({
                     "obs": [float(x) for x in s["obs"][t, n].reshape(-1)],
                     "action": int(s["actions"][t, n]),
                     "reward": float(s["rewards"][t, n]),
                     "done": bool(s["dones"][t, n]),
                     "logp": float(s["logp"][t, n]),
                 })
+            if seg_rows:
+                seg_rows[-1]["done"] = True
+            rows.extend(seg_rows)
         episodes_done += s["num_episodes"]
     ds = rd.from_items(rows, parallelism=8)
     if fmt == "parquet":

@@ -469,8 +469,7 @@ class ProxyActor:
         # the reference's default HTTP proxy, so exposure is a deliberate
         # deployment decision.
         ip = node_ip()
-        bind_host = host if host is not None else \
-            (ip if ip != "127.0.0.1" else "127.0.0.1")
+        bind_host = host if host is not None else ip
         self._server = ThreadingHTTPServer((bind_host, port), Handler)
         self._server.daemon_threads = True
         self.address = f"{ip}:{self._server.server_address[1]}"

@@ -100,3 +100,36 @@ def test_fixed_point_resources_no_drift(cluster):
     for _ in range(1000):
         y = y - 0.1 + 0.1
     assert _fpq(y) == 4.0
+
+
+def test_gcs_client_typed_accessors(cluster):
+    """Typed accessor suite over the head (reference:
+    src/ray/gcs/gcs_client/accessor.h:43-583)."""
+    from ray_tpu.core.gcs_client import GcsClient
+
+    gcs = GcsClient()
+    nodes = gcs.get_all_node_info()
+    assert nodes and nodes[0]["alive"] and "CPU" in nodes[0]["resources"]
+    assert gcs.get_node_info(nodes[0]["node_id"])["address"]
+    assert gcs.get_cluster_resources()["CPU"] >= 4.0
+
+    assert gcs.internal_kv_put("k1", b"v1") is True
+    assert gcs.internal_kv_put("k1", b"v2", overwrite=False) is False
+    assert gcs.internal_kv_get("k1") == b"v1"
+    assert "k1" in gcs.internal_kv_keys("k")
+    assert gcs.internal_kv_del("k1") is True
+    assert gcs.internal_kv_get("k1") is None
+
+    @ray_tpu.remote(num_cpus=0)
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.options(name="gcs_probe").remote()
+    ray_tpu.get(a.ping.remote())
+    actors = gcs.get_all_actor_info()
+    assert any(x.get("name") == "gcs_probe" for x in actors)
+    named = gcs.get_named_actor_info("gcs_probe")
+    assert named.get("found")
+    assert gcs.get_task_events(limit=10) is not None
+    ray_tpu.kill(a)
