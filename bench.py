@@ -50,6 +50,25 @@ def _ppo_bench_subprocess() -> float:
         return 0.0
 
 
+
+def _time_steps(step, state, batch, mesh, warmup: int, steps: int):
+    """Warmup, then time `steps` compiled steps. Sync via a device-to-
+    host copy of the loss — block_until_ready is not a reliable barrier
+    on every PJRT plugin. Returns (state, final_loss, seconds)."""
+    import time as _time
+
+    with mesh:
+        for _ in range(warmup):
+            state, metrics = step(state, batch)
+        float(metrics["loss"])
+        t0 = _time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, batch)
+        final_loss = float(metrics["loss"])
+        dt = _time.perf_counter() - t0
+    return state, final_loss, dt
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -101,22 +120,38 @@ def main():
     batch = jax.device_put(batch, batch_shardings(mesh, batch))
 
     step = make_train_step(lambda p, b: gpt2_loss(p, b, cfg), tx)
-    with mesh:
-        for _ in range(warmup):
-            state, metrics = step(state, batch)
-        # device-to-host copy as the sync point: block_until_ready is not
-        # a reliable barrier on every PJRT plugin
-        float(metrics["loss"])
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = step(state, batch)
-        final_loss = float(metrics["loss"])
-        dt = time.perf_counter() - t0
+    state, final_loss, dt = _time_steps(step, state, batch, mesh, warmup,
+                                        steps)
 
     tokens_per_sec = B * seq * steps / dt
     per_chip = tokens_per_sec / n
     # MFU against v5e peak 197 TFLOP/s bf16 (fwd+bwd ~ 6*N flops/token)
     mfu = 6.0 * n_params * per_chip / 197e12 if on_tpu else 0.0
+
+    # second model family: Llama-small (RoPE/RMSNorm/SwiGLU/GQA) on the
+    # same chip + timing recipe
+    llama_per_chip = 0.0
+    if on_tpu:
+        from ray_tpu.models.llama import (
+            LlamaConfig,
+            init_llama,
+            llama_loss,
+            llama_partition_rules,
+        )
+
+        lcfg = LlamaConfig.small()
+        lstate = init_sharded_state(
+            lambda: init_llama(jax.random.PRNGKey(0), lcfg),
+            tx, mesh, llama_partition_rules())
+        ltoks = jax.random.randint(
+            jax.random.PRNGKey(2), (B, seq + 1), 0, lcfg.vocab_size,
+            jnp.int32)
+        lbatch = {"tokens": ltoks[:, :-1], "targets": ltoks[:, 1:]}
+        lbatch = jax.device_put(lbatch, batch_shardings(mesh, lbatch))
+        lstep = make_train_step(lambda p, b: llama_loss(p, b, lcfg), tx)
+        lstate, _lloss, ldt = _time_steps(lstep, lstate, lbatch, mesh,
+                                          warmup, steps)
+        llama_per_chip = B * seq * steps / ldt / n
 
     # secondary: RLlib PPO sampling+learning throughput. The env loop and
     # small-MLP learner are host-side by design (BASELINE north star
@@ -141,6 +176,8 @@ def main():
                     "step_ms": round(1e3 * dt / steps, 1),
                     "mfu": round(mfu, 3),
                     "loss": round(final_loss, 4),
+                    "llama_small_tokens_per_sec_per_chip":
+                        round(llama_per_chip, 1),
                     "ppo_env_steps_per_sec": round(ppo_steps_per_sec, 0),
                 },
             }

@@ -69,6 +69,8 @@ class Head:
         from collections import deque as _dq
 
         self._task_events = _dq(maxlen=10000)
+        # long-poll subscriber mailboxes: sub_id -> {topics, queue, cond}
+        self._poll_subs: dict = {}
         self._queue_lens: dict[bytes, int] = {}  # pending tasks per node
         self._stopped = threading.Event()
         # storage writes are queued IN LOCK ORDER and drained by one
@@ -93,6 +95,8 @@ class Head:
         s.register("get_named_actor", self._h_get_named_actor)
         s.register("kill_actor", self._h_kill_actor)
         s.register("subscribe", self._h_subscribe)
+        s.register("poll_messages", self._h_poll_messages, slow=True)
+        s.register("unsubscribe", self._h_unsubscribe)
         s.register("publish", self._h_publish, oneway=True)
         s.register("create_pg", self._h_create_pg)
         s.register("pg_table", self._h_pg_table)
@@ -508,9 +512,48 @@ class Head:
     # ------------------------------------------------------------ pubsub
 
     def _h_subscribe(self, msg, frames):
+        """Push subscription (address fanout) or, with mode="poll", a
+        LONG-POLL subscriber: the head buffers messages per subscriber id
+        and poll_messages drains them — a briefly-unreachable subscriber
+        loses nothing (reference: the long-poll publisher's per-subscriber
+        mailboxes, src/ray/pubsub/publisher.h:297)."""
+        if msg.get("mode") == "poll":
+            sub_id = msg["subscriber_id"]
+            with self._lock:
+                from collections import deque
+
+                box = self._poll_subs.setdefault(
+                    sub_id, {"topics": set(), "queue": deque(maxlen=1000),
+                             "cond": threading.Condition(self._lock),
+                             "last_seen": time.monotonic()})
+                box["topics"].update(msg["topics"])
+            return {"subscribed": True}
         with self._lock:
             for t in msg["topics"]:
                 self._subs.setdefault(t, set()).add(msg["address"])
+        return {}
+
+    def _h_poll_messages(self, msg, frames):
+        """Long-poll drain: blocks until messages exist or the timeout
+        lapses; returns the whole buffered batch."""
+        sub_id = msg["subscriber_id"]
+        timeout = min(float(msg.get("timeout", 10.0)), 25.0)
+        with self._lock:
+            box = self._poll_subs.get(sub_id)
+            if box is None:
+                return {"messages": [], "subscribed": False}
+            box["last_seen"] = time.monotonic()
+            if not box["queue"]:
+                box["cond"].wait(timeout)
+            out = list(box["queue"])
+            box["queue"].clear()
+        return {"messages": out, "subscribed": True}
+
+    def _h_unsubscribe(self, msg, frames):
+        with self._lock:
+            self._poll_subs.pop(msg.get("subscriber_id"), None)
+            for t in msg.get("topics", []):
+                self._subs.get(t, set()).discard(msg.get("address"))
         return {}
 
     def _h_publish(self, msg, frames):
@@ -519,6 +562,16 @@ class Head:
     def _publish(self, topic: str, data: dict):
         with self._lock:
             subs = list(self._subs.get(topic, ()))
+            stale = time.monotonic() - 120.0
+            for sub_id, box in list(self._poll_subs.items()):
+                if box["last_seen"] < stale:
+                    # reap abandoned mailboxes (reference: publisher GC of
+                    # dead long-poll subscribers)
+                    self._poll_subs.pop(sub_id, None)
+                    continue
+                if topic in box["topics"]:
+                    box["queue"].append({"topic": topic, "data": data})
+                    box["cond"].notify_all()
         for addr in subs:
             try:
                 self.client.send_oneway(addr, "pubsub", {"topic": topic, "data": data})

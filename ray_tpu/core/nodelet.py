@@ -162,7 +162,7 @@ class Nodelet:
         s.register("stop_actor", self._h_stop_actor)
         s.register("worker_ready", self._h_worker_ready)
         s.register("task_finished", self._h_task_finished, oneway=True)
-        s.register("fetch_object", self._h_fetch_object)
+        s.register("fetch_object", self._h_fetch_object, slow=True)
         s.register("object_meta", self._h_object_meta)
         s.register("pull_chunk", self._h_pull_chunk)
         s.register("pull_object", self._h_pull_object)

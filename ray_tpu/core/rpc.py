@@ -138,13 +138,20 @@ class RpcServer:
         self._handlers: dict[str, tuple] = {}
         self._pool = ThreadPoolExecutor(max_workers=num_threads,
                                         thread_name_prefix=f"{name}-h")
+        # SLOW lane: handlers that legitimately park (long-polls, bulk
+        # transfers) run here so they can never starve the control-plane
+        # pool (reference: separate gRPC completion queues for long-poll
+        # pubsub vs control RPCs)
+        self._slow_pool = ThreadPoolExecutor(max_workers=num_threads,
+                                             thread_name_prefix=f"{name}-s")
         self._send_lock = threading.Lock()
         self._stopped = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"{name}-recv")
 
-    def register(self, method: str, fn, oneway: bool = False):
-        self._handlers[method] = (fn, oneway)
+    def register(self, method: str, fn, oneway: bool = False,
+                 slow: bool = False):
+        self._handlers[method] = (fn, oneway, slow)
 
     def start(self):
         self._thread.start()
@@ -164,9 +171,13 @@ class RpcServer:
                 continue
             ident, msg_id, method_b, payload = parts[0], parts[1], parts[2], parts[3]
             frames = [bytes(f) for f in parts[4:]]
+            method = method_b.decode()
+            entry = self._handlers.get(method)
+            pool = (self._slow_pool if entry is not None and entry[2]
+                    else self._pool)
             try:
-                self._pool.submit(self._dispatch, ident, msg_id,
-                                  method_b.decode(), payload, frames)
+                pool.submit(self._dispatch, ident, msg_id, method,
+                            payload, frames)
             except RuntimeError:
                 return  # pool shut down mid-teardown: stop receiving
 
@@ -176,7 +187,7 @@ class RpcServer:
             self._reply(ident, msg_id, _ERR,
                         ser.dumps_msg(RpcError(f"no handler for {method!r}")))
             return
-        fn, oneway = entry
+        fn, oneway, _slow = entry
         try:
             msg = ser.loads_msg(payload) if payload else {}
             result = fn(msg, frames)
@@ -206,6 +217,7 @@ class RpcServer:
         self._stopped.set()
         self._thread.join(timeout=2)
         self._pool.shutdown(wait=False)
+        self._slow_pool.shutdown(wait=False)
         try:
             self._sock.close(0)
         except Exception:

@@ -196,6 +196,11 @@ class Dataset:
 
         import ray_tpu as rt
 
+        from ray_tpu.data.executor import (
+            StreamingExecutor,
+            default_policies,
+        )
+
         class _PoolWorker:
             def apply(self, block):
                 return apply_fn(fused(block))
@@ -203,13 +208,17 @@ class Dataset:
         cls = rt.remote(num_cpus=1)(_PoolWorker)
         actors = [cls.remote() for _ in builtins.range(num_actors)]
         try:
-            pending = []
-            for i, ref in enumerate(self._block_refs):
-                a = actors[i % num_actors]
-                pending.append(a.apply.remote(ref))
-                if len(pending) >= limit:
-                    yield pending.pop(0)
-            yield from pending
+            # same resource-managed executor as the task path: the actor
+            # pool must not outrun the consumer's memory budget either
+            executor = StreamingExecutor(default_policies(
+                max_in_flight=limit, memory_budget=memory_budget))
+            self._last_executor = executor
+            counter = iter(builtins.range(1 << 62))
+
+            def submit(ref):
+                return actors[next(counter) % num_actors].apply.remote(ref)
+
+            yield from executor.run(list(self._block_refs), submit)
         finally:
             for a in actors:
                 try:

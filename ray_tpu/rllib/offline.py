@@ -43,9 +43,10 @@ def record_experiences(env: str, num_episodes: int, out_dir: str,
         T, N = s["rewards"].shape
         # ENV-MAJOR row order: each env's steps are contiguous and
         # time-ordered so downstream return scans chain within one
-        # trajectory only. The last row of each env's fragment segment is
-        # marked done (truncation) so a return scan never crosses into a
-        # different env's rows.
+        # trajectory only. The last row of each env's fragment segment
+        # carries an explicit TRUNCATED flag (distinct from `done`, like
+        # gymnasium's terminated/truncated split) so return scans stop at
+        # the boundary without mistaking it for a real terminal.
         for n in range(N):
             seg_rows = []
             for t in range(T):
@@ -56,10 +57,11 @@ def record_experiences(env: str, num_episodes: int, out_dir: str,
                     "action": int(s["actions"][t, n]),
                     "reward": float(s["rewards"][t, n]),
                     "done": bool(s["dones"][t, n]),
+                    "truncated": False,
                     "logp": float(s["logp"][t, n]),
                 })
-            if seg_rows:
-                seg_rows[-1]["done"] = True
+            if seg_rows and not seg_rows[-1]["done"]:
+                seg_rows[-1]["truncated"] = True
             rows.extend(seg_rows)
         episodes_done += s["num_episodes"]
     ds = rd.from_items(rows, parallelism=8)
@@ -131,9 +133,13 @@ class BC:
         obs = np.asarray([r["obs"] for r in rows], np.float32)
         acts = np.asarray([r["action"] for r in rows], np.int64)
         rews = np.asarray([r["reward"] for r in rows], np.float32)
-        dones = np.asarray([r["done"] for r in rows], np.bool_)
+        # return chains break at real terminals AND at recording
+        # truncations (fragment boundaries) — a truncated chain's return
+        # is a known underestimate, never a cross-trajectory mix
+        dones = np.asarray([r["done"] or r.get("truncated", False)
+                            for r in rows], np.bool_)
         # Monte-Carlo returns per (recorded) trajectory for MARWIL's
-        # advantage weighting; episode boundaries come from `done`
+        # advantage weighting
         returns = np.zeros(len(rows), np.float32)
         g = 0.0
         for i in range(len(rows) - 1, -1, -1):

@@ -133,3 +133,47 @@ def test_gcs_client_typed_accessors(cluster):
     assert named.get("found")
     assert gcs.get_task_events(limit=10) is not None
     ray_tpu.kill(a)
+
+
+def test_pubsub_long_poll_subscriber(cluster):
+    """Long-poll subscription buffers messages while the subscriber is
+    away (reference: per-subscriber mailboxes, pubsub/publisher.h:297)."""
+    import threading
+
+    from ray_tpu.core.api import _global_runtime
+
+    rt = _global_runtime()
+    head = rt.head_address
+    sub = {"subscriber_id": "test-sub-1", "topics": ["custom"],
+           "mode": "poll"}
+    assert rt.client.call(head, "subscribe", sub, timeout=10)["subscribed"]
+    # publish while NOT polling: messages buffer instead of dropping
+    for i in range(3):
+        rt.client.send_oneway(head, "publish",
+                              {"topic": "custom", "data": {"i": i}})
+    deadline = time.monotonic() + 10
+    msgs = []
+    while time.monotonic() < deadline and len(msgs) < 3:
+        r = rt.client.call(head, "poll_messages",
+                           {"subscriber_id": "test-sub-1", "timeout": 1.0},
+                           timeout=30)
+        msgs.extend(r["messages"])
+    assert [m["data"]["i"] for m in msgs] == [0, 1, 2]
+
+    # long-poll blocks until a message arrives
+    got = {}
+
+    def poll():
+        got.update(rt.client.call(
+            head, "poll_messages",
+            {"subscriber_id": "test-sub-1", "timeout": 8.0}, timeout=30))
+
+    t = threading.Thread(target=poll)
+    t.start()
+    time.sleep(0.3)
+    rt.client.send_oneway(head, "publish",
+                          {"topic": "custom", "data": {"i": 99}})
+    t.join(timeout=10)
+    assert [m["data"]["i"] for m in got["messages"]] == [99]
+    rt.client.call(head, "unsubscribe",
+                   {"subscriber_id": "test-sub-1"}, timeout=10)
