@@ -57,6 +57,8 @@ def _time_steps(step, state, batch, mesh, warmup: int, steps: int):
     on every PJRT plugin. Returns (state, final_loss, seconds)."""
     import time as _time
 
+    # at least one warmup step: it also binds `metrics` for the sync read
+    warmup = max(1, warmup)
     with mesh:
         for _ in range(warmup):
             state, metrics = step(state, batch)

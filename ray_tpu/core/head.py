@@ -236,6 +236,14 @@ class Head:
                     if info.alive and now - self._last_beat.get(nid, 0) > NODE_DEATH_AFTER_S:
                         info.alive = False
                         dead.append(nid)
+                # timer-driven GC of abandoned long-poll mailboxes (must
+                # not depend on publishes happening: quiet clusters would
+                # otherwise leak dead subscribers' buffers forever)
+                stale = now - 120.0
+                for sub_id, box in list(self._poll_subs.items()):
+                    if box["last_seen"] < stale:
+                        self._poll_subs.pop(sub_id, None)
+                        box["cond"].notify_all()
             for nid in dead:
                 self._on_node_death(nid)
 
@@ -545,13 +553,19 @@ class Head:
             box["last_seen"] = time.monotonic()
             if not box["queue"]:
                 box["cond"].wait(timeout)
+            if self._poll_subs.get(sub_id) is not box:
+                # unsubscribed (or GC'd) while parked
+                return {"messages": [], "subscribed": False}
             out = list(box["queue"])
             box["queue"].clear()
         return {"messages": out, "subscribed": True}
 
     def _h_unsubscribe(self, msg, frames):
         with self._lock:
-            self._poll_subs.pop(msg.get("subscriber_id"), None)
+            box = self._poll_subs.pop(msg.get("subscriber_id"), None)
+            if box is not None:
+                # wake any parked poll so its slow-lane thread frees now
+                box["cond"].notify_all()
             for t in msg.get("topics", []):
                 self._subs.get(t, set()).discard(msg.get("address"))
         return {}
@@ -562,13 +576,7 @@ class Head:
     def _publish(self, topic: str, data: dict):
         with self._lock:
             subs = list(self._subs.get(topic, ()))
-            stale = time.monotonic() - 120.0
-            for sub_id, box in list(self._poll_subs.items()):
-                if box["last_seen"] < stale:
-                    # reap abandoned mailboxes (reference: publisher GC of
-                    # dead long-poll subscribers)
-                    self._poll_subs.pop(sub_id, None)
-                    continue
+            for box in self._poll_subs.values():
                 if topic in box["topics"]:
                     box["queue"].append({"topic": topic, "data": data})
                     box["cond"].notify_all()

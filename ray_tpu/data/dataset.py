@@ -172,21 +172,15 @@ class Dataset:
             yield from self._block_refs
             return
         fused = _fuse(self._ops)
-        limit = max_in_flight or max(
-            2, int(ray_tpu.cluster_resources().get("CPU", 4)))
+        from ray_tpu.data.executor import StreamingExecutor, default_policies
 
         if actor_stage is None:
-            from ray_tpu.data.executor import (
-                StreamingExecutor,
-                default_policies,
-            )
-
             @ray_tpu.remote(num_cpus=1)
             def _apply_block(block):
                 return fused(block)
 
             executor = StreamingExecutor(default_policies(
-                max_in_flight=limit, memory_budget=memory_budget))
+                max_in_flight=max_in_flight, memory_budget=memory_budget))
             self._last_executor = executor  # observability / tests
             yield from executor.run(list(self._block_refs),
                                     lambda ref: _apply_block.remote(ref))
@@ -195,11 +189,6 @@ class Dataset:
         apply_fn, num_actors = actor_stage
 
         import ray_tpu as rt
-
-        from ray_tpu.data.executor import (
-            StreamingExecutor,
-            default_policies,
-        )
 
         class _PoolWorker:
             def apply(self, block):
@@ -211,7 +200,7 @@ class Dataset:
             # same resource-managed executor as the task path: the actor
             # pool must not outrun the consumer's memory budget either
             executor = StreamingExecutor(default_policies(
-                max_in_flight=limit, memory_budget=memory_budget))
+                max_in_flight=max_in_flight, memory_budget=memory_budget))
             self._last_executor = executor
             counter = iter(builtins.range(1 << 62))
 
@@ -480,13 +469,16 @@ def _read_source(paths, read_block) -> Dataset:
 
 
 def read_text(paths) -> Dataset:
-    """One row per line (reference: ray.data.read_text)."""
+    """One row per line (reference: ray.data.read_text). The line
+    splitting runs in the native mmap scanner (data/lineio.py ->
+    _native/lineio.cc) inside the read task."""
 
     def rd(block):
+        from ray_tpu.data.lineio import read_lines
+
         out = []
         for path in block:
-            with open(path) as f:
-                out.extend(line.rstrip("\n") for line in f)
+            out.extend(read_lines(path))
         return out
 
     return _read_source(paths, rd)
@@ -514,10 +506,12 @@ def read_json(paths) -> Dataset:
     def rd(block):
         import json
 
+        from ray_tpu.data.lineio import read_lines
+
         out = []
         for path in block:
-            with open(path) as f:
-                out.extend(json.loads(line) for line in f if line.strip())
+            out.extend(json.loads(line) for line in read_lines(path)
+                       if line.strip())
         return out
 
     return _read_source(paths, rd)

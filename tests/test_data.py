@@ -107,3 +107,23 @@ def test_read_csv(cluster, tmp_path):
     (tmp_path / "d.csv").write_text("a,b\n1,x\n2,y\n")
     rows = rd.read_csv(str(tmp_path / "d.csv")).take_all()
     assert rows == [{"a": "1", "b": "x"}, {"a": "2", "b": "y"}]
+
+
+def test_native_lineio_matches_python(tmp_path):
+    """The native mmap line scanner (third C++ component) agrees with
+    Python file iteration on edge cases."""
+    from ray_tpu.data.lineio import _lineio_lib, read_lines
+
+    cases = {
+        "plain": "a\nbb\nccc\n",
+        "no_trailing_newline": "x\ny",
+        "empty_lines": "\n\na\n\n",
+        "empty_file": "",
+        "one_line": "only",
+    }
+    for name, content in cases.items():
+        p = tmp_path / f"{name}.txt"
+        p.write_text(content)
+        expected = content.splitlines()
+        assert read_lines(str(p)) == expected, name
+    assert _lineio_lib() is not None, "native lineio failed to build"
