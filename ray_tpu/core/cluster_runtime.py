@@ -162,6 +162,10 @@ class ClusterRuntime:
         self._task_lease: dict[bytes, tuple] = {}  # task_id -> (lease, spec)
         # in-flight submission acks: [deadline, future, resend_fn, fail_fn]
         self._pending_acks: list = []
+        # gc-driven oneways (frees/borrow releases) flushed by the sweeper
+        from collections import deque as _deque
+
+        self._deferred_sends: _deque = _deque()
         # per-key lease cap: bounds CLUSTER-wide workers one submitter can
         # hold, not this process's cores — nodelet denials (with 50ms
         # negative caching) are the real admission control
@@ -275,11 +279,13 @@ class ClusterRuntime:
         if st is not None:
             self._free_remote_bytes(st, b)
         elif borrowed_from is not None:
-            try:
-                self.client.send_oneway(borrowed_from, "borrow_release",
-                                        {"oid": b, "borrower": self.address})
-            except Exception:
-                pass
+            # DEFERRED: _decref runs from __del__ at arbitrary gc points —
+            # a gc firing between another send's multipart frames must not
+            # interleave a new message on the same socket. The sweeper
+            # flushes these from its own thread.
+            self._deferred_sends.append(
+                (borrowed_from, "borrow_release",
+                 {"oid": b, "borrower": self.address}))
 
     def _free_remote_bytes(self, st: "_Owned", b: bytes):
         if st.spilled_path is not None:
@@ -291,12 +297,22 @@ class ClusterRuntime:
             return
         with self._lock:
             if st.location is not None and self.nodelet_address:
-                try:
-                    target = (self.nodelet_address if st.location == "local"
-                              else st.location)
-                    self.client.send_oneway(target, "free_object", {"oid": b})
-                except Exception:
-                    pass
+                target = (self.nodelet_address if st.location == "local"
+                          else st.location)
+                # deferred for the same gc-reentrancy reason as above
+                self._deferred_sends.append(
+                    (target, "free_object", {"oid": b}))
+
+    def _flush_deferred_sends(self):
+        while True:
+            try:
+                target, method, msg = self._deferred_sends.popleft()
+            except IndexError:
+                return
+            try:
+                self.client.send_oneway(target, method, msg)
+            except Exception:  # noqa: BLE001
+                pass
 
     # ------------------------------------------------------------ objects
 
@@ -1196,6 +1212,7 @@ class ClusterRuntime:
         and idle-lease return."""
         while not self._shutdown_flag:
             time.sleep(0.25)
+            self._flush_deferred_sends()
             now = time.monotonic()
             resend, fail = [], []
             with self._lock:
@@ -1571,6 +1588,7 @@ class ClusterRuntime:
             return
         self._shutdown_flag = True
         atexit.unregister(self.shutdown)
+        self._flush_deferred_sends()  # don't drop queued frees
         # hand leased workers back (the nodelet's TTL would reclaim them,
         # but a clean return keeps the pool warm for the next driver)
         with self._lock:

@@ -88,7 +88,12 @@ class LocalRuntime:
         self.namespace = namespace or "default"
         self._objects: dict[ObjectID, _Slot] = {}
         self._refcounts: dict[ObjectID, int] = {}
-        self._objects_lock = threading.Lock()
+        # RLock: _decref runs from ObjectRef.__del__ at ARBITRARY gc
+        # points, including while this same thread holds the lock (e.g.
+        # an allocation inside _slot's critical section triggers gc) — a
+        # plain Lock self-deadlocks there. Reentrant dict pops of OTHER
+        # oids are safe against every critical section below.
+        self._objects_lock = threading.RLock()
         self._actors: dict[ActorID, _LocalActor] = {}
         self._named: dict[tuple[str, str], ActorID] = {}
         self._actors_lock = threading.Lock()
@@ -105,9 +110,11 @@ class LocalRuntime:
     def _slot(self, oid: ObjectID) -> _Slot:
         with self._objects_lock:
             s = self._objects.get(oid)
-            if s is None:
-                s = self._objects[oid] = _Slot()
-            return s
+            if s is not None:
+                return s
+        fresh = _Slot()  # allocate OUTSIDE the lock: gc can run here
+        with self._objects_lock:
+            return self._objects.setdefault(oid, fresh)
 
     # Local reference counting driven by ObjectRef lifetime (reference:
     # ReferenceCounter, core_worker/reference_count.h:66). When the last

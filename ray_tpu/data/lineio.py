@@ -2,10 +2,13 @@
 
 Reference parity: the datasource hot loops run in native code in the
 reference (Arrow C++ readers behind ray.data.read_text/read_json);
-here `_native/lineio.cc` mmaps the file and builds the line-offset
-index in one C sweep, and Python decodes slices on demand — the
-framework's third native component beside the object store and shm
-channels. Falls back to pure-Python iteration when no toolchain exists.
+here `_native/lineio.cc`'s memchr sweep builds the line-offset index
+over the file bytes in one C pass — the framework's third native
+component beside the object store and shm channels. The file itself is
+read through normal Python I/O so open/permission errors surface
+exactly like the pure-Python fallback and a concurrently-truncated
+file can never SIGBUS the worker (no mmap is exposed to Python).
+Falls back to pure-Python splitting when no toolchain exists.
 """
 
 from __future__ import annotations
@@ -30,56 +33,47 @@ def _lineio_lib():
                 lib = ctypes.CDLL(path)
                 u64 = ctypes.c_uint64
                 u64p = ctypes.POINTER(u64)
-                lib.lio_open.argtypes = [ctypes.c_char_p,
-                                         ctypes.POINTER(ctypes.c_void_p),
-                                         u64p]
-                lib.lio_open.restype = ctypes.c_int
-                lib.lio_index.argtypes = [ctypes.c_void_p, u64, u64p, u64]
+                lib.lio_index.argtypes = [ctypes.c_char_p, u64, u64p, u64]
                 lib.lio_index.restype = u64
-                lib.lio_close.argtypes = [ctypes.c_void_p, u64]
                 _lib = lib
     return _lib or None
 
 
 def read_lines(path: str, strip_newline: bool = True) -> list[str]:
-    """All lines of a file (the native mmap+index path when available).
-    LF and CRLF line endings are handled; lone-CR (classic Mac) files
-    are not split by the native path."""
+    """All lines of a file. LF and CRLF endings are handled; lone-CR
+    (classic Mac) files are not split by the native path."""
     lib = _lineio_lib()
     if lib is None:
         with open(path) as f:
             if strip_newline:
                 return [ln.rstrip("\n") for ln in f]
             return list(f)
-    base = ctypes.c_void_p()
-    size = ctypes.c_uint64()
-    if lib.lio_open(path.encode(), ctypes.byref(base), ctypes.byref(size)):
-        raise FileNotFoundError(path)
-    try:
-        if size.value == 0:
-            return []
-        n = lib.lio_index(base, size.value, None, 0)
-        offs = (ctypes.c_uint64 * n)()
-        lib.lio_index(base, size.value, offs, n)
-        buf = (ctypes.c_char * size.value).from_address(base.value)
-        mem = memoryview(buf)
-        out = []
-        for i in range(n):
-            start = offs[i]
-            if i + 1 < n:
-                end = offs[i + 1] - 1  # the newline position
-            else:
-                end = size.value  # final line runs to EOF...
-                if end > start and bytes(mem[end - 1:end]) == b"\n":
-                    end -= 1  # ...unless the file is newline-terminated
-            raw = bytes(mem[start:end])
-            if raw.endswith(b"\r"):
-                raw = raw[:-1]  # CRLF files: match text-mode translation
-            # strict decode: bad encodings must RAISE at the read site
-            # like the text-mode fallback, not flow downstream mangled
-            line = raw.decode()
-            out.append(line if strip_newline else line + "\n")
-        del mem, buf
-        return out
-    finally:
-        lib.lio_close(base, size.value)
+    with open(path, "rb") as f:
+        data = f.read()
+    if not data:
+        return []
+    n = lib.lio_index(data, len(data), None, 0)
+    offs = (ctypes.c_uint64 * n)()
+    lib.lio_index(data, len(data), offs, n)
+    out = []
+    size = len(data)
+    for i in range(n):
+        start = offs[i]
+        if i + 1 < n:
+            end = offs[i + 1] - 1  # the newline position
+            had_newline = True
+        else:
+            end = size  # final line runs to EOF...
+            had_newline = data.endswith(b"\n")
+            if had_newline:
+                end -= 1  # ...unless the file is newline-terminated
+        raw = data[start:end]
+        if raw.endswith(b"\r"):
+            raw = raw[:-1]  # CRLF files: match text-mode translation
+        # strict decode: bad encodings must RAISE at the read site like
+        # the text-mode fallback, not flow downstream mangled
+        line = raw.decode()
+        if not strip_newline and had_newline:
+            line += "\n"
+        out.append(line)
+    return out

@@ -83,3 +83,38 @@ def test_resolve_retry_after_drop(chaos_cluster):
     # worker resolving the borrowed arg hits its own (worker-process)
     # chaos budget only via env; driver-side drop exercises our wait path
     assert ray_tpu.get(consume.remote(ref), timeout=90) == 45
+
+
+def test_lease_request_drop_falls_back(chaos_cluster):
+    """Dropping the lease grant forces the classic scheduling path —
+    the task still completes (submitter-side fallback)."""
+
+    @ray_tpu.remote(num_cpus=0.2)
+    def val(x):
+        return x * 3
+
+    assert ray_tpu.get(val.remote(2), timeout=60) == 6  # warm
+    rpc.set_chaos("request_lease=2")
+    assert ray_tpu.get(val.remote(5), timeout=90) == 15
+    rpc.set_chaos("")
+
+
+def test_leased_push_drop_recovered_by_ack_sweeper(chaos_cluster):
+    """A dropped execute_leased push never reaches the worker; the
+    submitter's ack sweeper resends after the (shortened) ack timeout,
+    and worker-side dedup keeps it exactly-once."""
+    import os
+
+    os.environ["RAY_TPU_ACK_TIMEOUT_S"] = "2"  # env reads are uncached
+
+    @ray_tpu.remote(num_cpus=0.2)
+    def bump(x):
+        return x + 100
+
+    try:
+        assert ray_tpu.get(bump.remote(1), timeout=60) == 101  # warm lease
+        rpc.set_chaos("execute_leased=1")
+        assert ray_tpu.get(bump.remote(7), timeout=90) == 107
+    finally:
+        rpc.set_chaos("")
+        os.environ.pop("RAY_TPU_ACK_TIMEOUT_S", None)

@@ -127,3 +127,23 @@ def test_native_lineio_matches_python(tmp_path):
         expected = content.splitlines()
         assert read_lines(str(p)) == expected, name
     assert _lineio_lib() is not None, "native lineio failed to build"
+
+
+def test_native_lineio_keep_newlines_and_errors(tmp_path):
+    """strip_newline=False matches text-mode iteration exactly, and
+    open errors surface like the fallback (no FileNotFoundError
+    masking)."""
+    import pytest as _pytest
+
+    from ray_tpu.data.lineio import read_lines
+
+    p = tmp_path / "t.txt"
+    p.write_text("a\nb")  # unterminated final line
+    assert read_lines(str(p), strip_newline=False) == ["a\n", "b"]
+    p2 = tmp_path / "crlf.txt"
+    p2.write_bytes(b"x\r\ny\r\n")
+    assert read_lines(str(p2)) == ["x", "y"]
+    with _pytest.raises(FileNotFoundError):
+        read_lines(str(tmp_path / "missing.txt"))
+    with _pytest.raises(IsADirectoryError):
+        read_lines(str(tmp_path))
