@@ -59,10 +59,12 @@ class _Owned:
         self.retries_left = retries_left
         self.size = 0  # serialized bytes (locality scoring)
         self.spilled_path: str | None = None  # disk tier (spilled primary)
-        # rpc addresses of processes borrowing this object's store bytes;
-        # the owner keeps the value alive until every borrower releases
-        # (reference: borrower bookkeeping, core_worker/reference_count.h:66)
-        self.borrowers: set[str] = set()
+        # borrowing processes: rpc address -> borrow EPOCH. The epoch
+        # makes deferred releases safe: a stale release from a previous
+        # borrow lifecycle of the same process cannot unregister a newer
+        # borrow (reference: borrower bookkeeping,
+        # core_worker/reference_count.h:66)
+        self.borrowers: dict[str, int] = {}
         self.cancelled = False
 
 
@@ -145,6 +147,7 @@ class ClusterRuntime:
         self._task_actor: dict[bytes, bytes] = {}  # task_id -> actor_id
         # objects we borrow (store bytes owned elsewhere): oid -> owner
         self._borrowed_owner: dict[bytes, str] = {}
+        self._borrow_epoch: dict[bytes, int] = {}
         self._rtenv_cache: dict = {}  # normalized runtime envs by content
         # Store buffers pinned because a deserialized object graph aliases
         # them zero-copy (plasma pin semantics); released when the owning
@@ -282,10 +285,13 @@ class ClusterRuntime:
             # DEFERRED: _decref runs from __del__ at arbitrary gc points —
             # a gc firing between another send's multipart frames must not
             # interleave a new message on the same socket. The sweeper
-            # flushes these from its own thread.
+            # flushes these from its own thread; the EPOCH lets the owner
+            # ignore this release if we re-borrow the oid before it lands.
+            with self._lock:
+                epoch = self._borrow_epoch.get(b, 0)
             self._deferred_sends.append(
                 (borrowed_from, "borrow_release",
-                 {"oid": b, "borrower": self.address}))
+                 {"oid": b, "borrower": self.address, "epoch": epoch}))
 
     def _free_remote_bytes(self, st: "_Owned", b: bytes):
         if st.spilled_path is not None:
@@ -489,12 +495,22 @@ class ClusterRuntime:
         owner = ref.owner
         if owner is None or owner == self.address:
             raise exc.ObjectLostError(f"no owner known for {ref}")
+        # new borrow LIFECYCLE: bump the epoch first so any deferred
+        # release queued from a previous lifecycle of this oid is stale
+        # at the owner (and purge it from our own queue)
+        with self._lock:
+            epoch = self._borrow_epoch.get(b, 0) + 1
+            self._borrow_epoch[b] = epoch
+            self._deferred_sends = type(self._deferred_sends)(
+                e for e in self._deferred_sends
+                if not (e[1] == "borrow_release" and e[2]["oid"] == b))
         while True:
             t = self._remaining(deadline)
             try:
                 value, frames = self.client.call_frames(
                     owner, "resolve",
-                    {"oid": b, "wait": True, "borrower": self.address},
+                    {"oid": b, "wait": True, "borrower": self.address,
+                     "epoch": epoch},
                     timeout=min(t, 5.0) if t is not None else 5.0)
             except PeerUnavailableError as e:
                 if "timed out" in str(e):
@@ -717,7 +733,7 @@ class ClusterRuntime:
                             return {"status": "inline"}, [f.read()]
                     except OSError:
                         return {"status": "unknown"}
-                st.borrowers.add(borrower)
+                st.borrowers[borrower] = int(msg.get("epoch", 0))
         if st.location == "local":
             # owner-local store: hand out bytes directly (borrower may be
             # anywhere; its nodelet pulls from our nodelet)
@@ -735,7 +751,10 @@ class ClusterRuntime:
             st = self._owned.get(b)
             if st is None:
                 return
-            st.borrowers.discard(msg["borrower"])
+            addr = msg["borrower"]
+            reg = st.borrowers.get(addr)
+            if reg is not None and reg <= int(msg.get("epoch", 1 << 62)):
+                st.borrowers.pop(addr, None)
             if st.borrowers or self._refcounts.get(b, 0) > 0 or \
                     not st.event.is_set():
                 return

@@ -203,8 +203,11 @@ class Head:
         with self._lock:
             if nid in self._nodes:
                 self._last_beat[nid] = time.monotonic()
-                self._available[nid] = msg["available"]
-                self._queue_lens[nid] = msg.get("queue_len", 0)
+                # delta sync: a payload-less beat is liveness-only (the
+                # nodelet's resources are unchanged — ray_syncer.h:83)
+                if "available" in msg:
+                    self._available[nid] = msg["available"]
+                    self._queue_lens[nid] = msg.get("queue_len", 0)
                 self._nodes[nid].alive = True
 
     def _h_cluster_view(self, msg, frames):

@@ -278,6 +278,13 @@ class Nodelet:
                 self._lease_demand[owner] = (time.monotonic() + 2.0, count)
 
     def _heartbeat_loop(self):
+        """Liveness beats every interval; the resource PAYLOAD rides only
+        when it changed (or every 10th beat as an anti-entropy refresh) —
+        the delta-sync idea of the reference's ray_syncer
+        (src/ray/common/ray_syncer/ray_syncer.h:83: only changed
+        components are broadcast), without the bidi-stream machinery."""
+        last_sent = None
+        beats_since_full = 0
         while not self._stopped.wait(HEARTBEAT_INTERVAL_S):
             now = time.monotonic()
             with self._lock:
@@ -287,13 +294,24 @@ class Nodelet:
                     self._lease_demand.pop(o, None)
                 qlen = len(self._queue) + sum(
                     c for _, c in self._lease_demand.values())
+            snapshot = (avail, qlen)
+            beats_since_full += 1
+            msg = {"node_id": self.node_id}
+            carries_payload = (snapshot != last_sent
+                               or beats_since_full >= 5)
+            if carries_payload:
+                msg["available"] = avail
+                msg["queue_len"] = qlen
             try:
-                self.client.send_oneway(self.head_address, "heartbeat",
-                                        {"node_id": self.node_id,
-                                         "available": avail,
-                                         "queue_len": qlen})
+                self.client.send_oneway(self.head_address, "heartbeat", msg)
             except Exception:
-                pass
+                continue  # don't mark the payload delivered
+            if carries_payload:
+                # commit AFTER the send attempt: a dropped payload beat
+                # must retry next interval, not go silent until the
+                # anti-entropy refresh
+                last_sent = snapshot
+                beats_since_full = 0
 
     # ------------------------------------------------------------ workers
 

@@ -229,3 +229,34 @@ def test_task_retry_on_worker_crash(cluster):
     import secrets
 
     assert ray_tpu.get(flaky.remote(secrets.token_hex(4)), timeout=60) == "recovered"
+
+
+def test_delta_heartbeats_keep_view_fresh(cluster):
+    """Payload-less liveness beats (delta sync, ray_syncer.h:83 role):
+    the head's availability view still reflects changes promptly, and
+    nodes stay alive through unchanged periods."""
+    import time as _t
+
+    before = {n["NodeID"]: n["Available"].get("CPU")
+              for n in ray_tpu.nodes()}
+
+    @ray_tpu.remote(num_cpus=2)
+    class Holder:
+        def ping(self):
+            return 1
+
+    h = Holder.remote()
+    ray_tpu.get(h.ping.remote())
+    deadline = _t.monotonic() + 10
+    changed = False
+    while _t.monotonic() < deadline and not changed:
+        now = {n["NodeID"]: n["Available"].get("CPU")
+               for n in ray_tpu.nodes()}
+        changed = any(now[k] != before.get(k) for k in now)
+        _t.sleep(0.3)
+    assert changed, "availability change never propagated"
+    # quiet period LONGER than NODE_DEATH_AFTER_S (5.0): if liveness-only
+    # beats were not actually sent, the monitor would mark nodes dead
+    _t.sleep(6.0)
+    assert all(n["Alive"] for n in ray_tpu.nodes())
+    ray_tpu.kill(h)
