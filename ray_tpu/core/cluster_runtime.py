@@ -147,7 +147,12 @@ class ClusterRuntime:
         self._task_actor: dict[bytes, bytes] = {}  # task_id -> actor_id
         # objects we borrow (store bytes owned elsewhere): oid -> owner
         self._borrowed_owner: dict[bytes, str] = {}
+        # oid -> epoch of the ACTIVE borrow lifecycle (popped on release
+        # so the dict never outgrows the live borrow set); epochs come
+        # from one global monotonic counter so a re-borrow always
+        # outranks any earlier queued release
         self._borrow_epoch: dict[bytes, int] = {}
+        self._borrow_epoch_counter = 0
         self._rtenv_cache: dict = {}  # normalized runtime envs by content
         # Store buffers pinned because a deserialized object graph aliases
         # them zero-copy (plasma pin semantics); released when the owning
@@ -287,11 +292,13 @@ class ClusterRuntime:
             # interleave a new message on the same socket. The sweeper
             # flushes these from its own thread; the EPOCH lets the owner
             # ignore this release if we re-borrow the oid before it lands.
+            # Epoch pop ends the lifecycle; append is under the lock so
+            # the entry can never land on an orphaned queue.
             with self._lock:
-                epoch = self._borrow_epoch.get(b, 0)
-            self._deferred_sends.append(
-                (borrowed_from, "borrow_release",
-                 {"oid": b, "borrower": self.address, "epoch": epoch}))
+                epoch = self._borrow_epoch.pop(b, 0)
+                self._deferred_sends.append(
+                    (borrowed_from, "borrow_release",
+                     {"oid": b, "borrower": self.address, "epoch": epoch}))
 
     def _free_remote_bytes(self, st: "_Owned", b: bytes):
         if st.spilled_path is not None:
@@ -310,11 +317,13 @@ class ClusterRuntime:
                     (target, "free_object", {"oid": b}))
 
     def _flush_deferred_sends(self):
-        while True:
-            try:
-                target, method, msg = self._deferred_sends.popleft()
-            except IndexError:
+        # drain under the lock (appenders hold it too), send outside it
+        with self._lock:
+            if not self._deferred_sends:
                 return
+            batch = list(self._deferred_sends)
+            self._deferred_sends.clear()
+        for target, method, msg in batch:
             try:
                 self.client.send_oneway(target, method, msg)
             except Exception:  # noqa: BLE001
@@ -495,15 +504,14 @@ class ClusterRuntime:
         owner = ref.owner
         if owner is None or owner == self.address:
             raise exc.ObjectLostError(f"no owner known for {ref}")
-        # new borrow LIFECYCLE: bump the epoch first so any deferred
-        # release queued from a previous lifecycle of this oid is stale
-        # at the owner (and purge it from our own queue)
+        # new borrow LIFECYCLE: take a GLOBALLY monotonic epoch — any
+        # release still queued from a previous lifecycle of this oid
+        # carries a smaller epoch and the owner ignores it after this
+        # registration (no queue purging: the queued release must still
+        # go out to clear the OLD registration if this resolve fails)
         with self._lock:
-            epoch = self._borrow_epoch.get(b, 0) + 1
-            self._borrow_epoch[b] = epoch
-            self._deferred_sends = type(self._deferred_sends)(
-                e for e in self._deferred_sends
-                if not (e[1] == "borrow_release" and e[2]["oid"] == b))
+            self._borrow_epoch_counter += 1
+            epoch = self._borrow_epoch_counter
         while True:
             t = self._remaining(deadline)
             try:
@@ -527,9 +535,11 @@ class ClusterRuntime:
             if status == "location":
                 # the owner registered us as a borrower atomically while
                 # serving this resolve (no free window between reply and
-                # registration); remember who to release to
+                # registration); remember who to release to + the epoch
+                # this lifecycle registered under
                 with self._lock:
                     self._borrowed_owner[b] = owner
+                    self._borrow_epoch[b] = epoch
                 return self._materialize(b, None, value["location"],
                                          value.get("store_name"))
             raise exc.ObjectLostError(f"{ref}: owner reports {status}")

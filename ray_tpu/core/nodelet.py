@@ -279,7 +279,7 @@ class Nodelet:
 
     def _heartbeat_loop(self):
         """Liveness beats every interval; the resource PAYLOAD rides only
-        when it changed (or every 10th beat as an anti-entropy refresh) —
+        when it changed (or every 5th beat as an anti-entropy refresh) —
         the delta-sync idea of the reference's ray_syncer
         (src/ray/common/ray_syncer/ray_syncer.h:83: only changed
         components are broadcast), without the bidi-stream machinery."""

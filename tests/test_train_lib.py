@@ -181,7 +181,19 @@ def _gpt2_loop(config):
             if config.get("crash_at") == step and ctx.get_world_rank() == 0 \
                     and train.get_checkpoint() is None:
                 import os
+                import time as _t
 
+                # let the driver DRAIN queued reports first (the prior
+                # checkpoint must be registered before we die, or the
+                # restart has nothing to resume from and crashes again —
+                # a load-dependent flake otherwise)
+                from ray_tpu.train import session as S
+
+                deadline = _t.monotonic() + 30
+                while not S.get_session().results.empty() and \
+                        _t.monotonic() < deadline:
+                    _t.sleep(0.05)
+                _t.sleep(0.5)  # pop->register window
                 os._exit(1)  # simulate a host loss mid-run (first try only)
             state, metrics = step_fn(state, batch)
             loss = float(np.asarray(metrics["loss"]))
