@@ -88,6 +88,26 @@ def _chaos_should_drop(method: str) -> bool:
 
 # ---------------------------------------------------------------- server
 
+def _send_nonblocking(sock, lock, parts, timeout: float = 10.0):
+    """Send under `lock` WITHOUT parking the lock on a full/disconnected
+    peer: NOBLOCK attempts with short sleeps between tries, so the recv
+    loop (which shares the lock) keeps draining replies while this
+    sender waits for HWM space."""
+    deadline = time.monotonic() + timeout
+    sleep = 1e-4
+    while True:
+        try:
+            with lock:
+                sock.send_multipart(parts, flags=zmq.NOBLOCK)
+            return
+        except zmq.Again:
+            if time.monotonic() > deadline:
+                raise PeerUnavailableError("send queue full (HWM)") from None
+            time.sleep(sleep)
+            sleep = min(sleep * 2, 0.01)
+
+
+
 
 def node_ip() -> str:
     """The IP this node's services bind and advertise.
@@ -164,7 +184,10 @@ class RpcServer:
             if not dict(poller.poll(timeout=100)):
                 continue
             try:
-                parts = self._sock.recv_multipart(zmq.NOBLOCK)
+                # share the reply-send lock: concurrent recv+send on one
+                # zmq socket can abort libzmq (mailbox assertion)
+                with self._send_lock:
+                    parts = self._sock.recv_multipart(zmq.NOBLOCK)
             except zmq.Again:
                 continue
             if len(parts) < 4:
@@ -207,11 +230,11 @@ class RpcServer:
                 self._reply(ident, msg_id, _ERR, blob)
 
     def _reply(self, ident, msg_id, status, payload, frames=()):
-        with self._send_lock:
-            try:
-                self._sock.send_multipart([ident, msg_id, status, payload, *frames])
-            except zmq.ZMQError:
-                pass  # peer gone
+        try:
+            _send_nonblocking(self._sock, self._send_lock,
+                              [ident, msg_id, status, payload, *frames])
+        except (zmq.ZMQError, PeerUnavailableError):
+            pass  # peer gone / queue full
 
     def stop(self):
         self._stopped.set()
@@ -249,7 +272,12 @@ class _Peer:
             if not dict(poller.poll(timeout=100)):
                 continue
             try:
-                parts = self.sock.recv_multipart(zmq.NOBLOCK)
+                # zmq sockets are not thread-safe: the non-blocking recv
+                # shares the send lock so it can never interleave with a
+                # concurrent send's socket operations (libzmq aborts with
+                # a mailbox assertion otherwise)
+                with self.send_lock:
+                    parts = self.sock.recv_multipart(zmq.NOBLOCK)
             except zmq.Again:
                 continue
             except zmq.ZMQError:
@@ -334,8 +362,8 @@ class RpcClient:
         if _chaos_should_drop(method):
             return msg_id, fut  # simulated drop: caller's timeout/retry fires
         payload = ser.dumps_msg(msg or {})
-        with peer.send_lock:
-            peer.sock.send_multipart([msg_id, method.encode(), payload, *frames])
+        _send_nonblocking(peer.sock, peer.send_lock,
+                          [msg_id, method.encode(), payload, *frames])
         return msg_id, fut
 
     def call(self, address: str, method: str, msg: dict | None = None,
@@ -376,8 +404,8 @@ class RpcClient:
         if _chaos_should_drop(method):
             return
         payload = ser.dumps_msg(msg or {})
-        with peer.send_lock:
-            peer.sock.send_multipart([b"\x00" * 8, method.encode(), payload, *frames])
+        _send_nonblocking(peer.sock, peer.send_lock,
+                          [b"\x00" * 8, method.encode(), payload, *frames])
 
     def drop_peer(self, address: str):
         with self._lock:

@@ -17,7 +17,6 @@ import urllib.request
 
 import cloudpickle
 import numpy as np
-import pytest
 
 import ray_tpu
 
@@ -31,14 +30,16 @@ def test_concurrent_subsystem_churn():
     from ray_tpu.dag import InputNode
 
     ray_tpu.init(num_cpus=8)
+    dag = None
     errors: list = []
     counts: dict[str, int] = {}
+    deadline = {"stop": 0.0}
 
     def guard(name, fn):
         def run():
             try:
                 n = 0
-                while time.monotonic() < stop:
+                while time.monotonic() < deadline["stop"]:
                     fn()
                     n += 1
                 counts[name] = n
@@ -49,62 +50,66 @@ def test_concurrent_subsystem_churn():
         t.start()
         return t
 
-    @ray_tpu.remote(num_cpus=0.5)
-    def make():
-        return np.arange(1 << 16)
-
-    @ray_tpu.remote(num_cpus=0.5)
-    def consume(a):
-        return int(a[0] + a[-1])
-
-    def borrow_churn():
-        refs = [make.remote() for _ in range(2)]
-        outs = ray_tpu.get([consume.remote(r) for r in refs], timeout=120)
-        assert outs == [65535, 65535], outs
-
-    @ray_tpu.remote(num_cpus=0.5)
-    class Echo:
-        def step(self, x):
-            return x + 1
-
-    echo = Echo.remote()
-    ray_tpu.get(echo.step.remote(0))
-    with InputNode() as inp:
-        node = echo.step.bind(inp)
-    dag = node.experimental_compile()
-
-    def dag_churn():
-        refs = [dag.execute(i) for i in range(20)]
-        assert [r.get(timeout=60) for r in refs] == \
-            [i + 1 for i in range(20)]
-
-    @serve.deployment
-    class Up:
-        def __call__(self, s):
-            return s.upper()
-
-    serve.run(Up.bind(), name="soak")
-    addr = serve.start_proxy(port=0)
-
-    def serve_churn():
-        req = urllib.request.Request(f"http://{addr}/soak",
-                                     data=json.dumps("hi").encode())
-        body = json.loads(urllib.request.urlopen(req, timeout=30).read())
-        assert body["result"] == "HI"
-
     saved_thresholds = gc.get_threshold()
-    gc.set_threshold(50, 5, 5)
     try:
-        # deadline starts AFTER the expensive setup above so slow hosts
-        # still get the full soak window
-        stop = time.monotonic() + SOAK_S
+        # SETUP inside the try: a failure here must still tear the
+        # runtime down or later test modules inherit a broken state
+        @ray_tpu.remote(num_cpus=0.5)
+        def make():
+            return np.arange(1 << 16)
+
+        @ray_tpu.remote(num_cpus=0.5)
+        def consume(a):
+            return int(a[0] + a[-1])
+
+        def borrow_churn():
+            refs = [make.remote() for _ in range(2)]
+            outs = ray_tpu.get([consume.remote(r) for r in refs],
+                               timeout=120)
+            assert outs == [65535, 65535], outs
+
+        @ray_tpu.remote(num_cpus=0.5)
+        class Echo:
+            def step(self, x):
+                return x + 1
+
+        echo = Echo.remote()
+        ray_tpu.get(echo.step.remote(0))
+        with InputNode() as inp:
+            node = echo.step.bind(inp)
+        dag = node.experimental_compile()
+
+        def dag_churn():
+            refs = [dag.execute(i) for i in range(20)]
+            assert [r.get(timeout=60) for r in refs] == \
+                [i + 1 for i in range(20)]
+
+        @serve.deployment
+        class Up:
+            def __call__(self, s):
+                return s.upper()
+
+        serve.run(Up.bind(), name="soak")
+        addr = serve.start_proxy(port=0)
+
+        def serve_churn():
+            req = urllib.request.Request(f"http://{addr}/soak",
+                                         data=json.dumps("hi").encode())
+            body = json.loads(
+                urllib.request.urlopen(req, timeout=30).read())
+            assert body["result"] == "HI"
+
+        gc.set_threshold(50, 5, 5)
+        # deadline starts AFTER setup so slow hosts get the full window
+        deadline["stop"] = time.monotonic() + SOAK_S
         threads = [guard("borrow", borrow_churn), guard("dag", dag_churn),
                    guard("serve", serve_churn)]
         for t in threads:
             t.join(timeout=SOAK_S + 120)
     finally:
         gc.set_threshold(*saved_thresholds)
-        dag.teardown()
+        if dag is not None:
+            dag.teardown()
         serve.shutdown()
         ray_tpu.shutdown()
     assert not errors, errors
