@@ -190,6 +190,7 @@ class ClusterRuntime:
         self.server.register("borrow_release", self._h_borrow_release,
                              oneway=True)
         self.server.register("pubsub", self._h_pubsub, oneway=True)
+        self.server.register("list_objects", self._h_list_objects)
         self.server.register("ping", lambda m, f: "pong")
         self.address = self.server.address
 
@@ -512,14 +513,17 @@ class ClusterRuntime:
         with self._lock:
             self._borrow_epoch_counter += 1
             epoch = self._borrow_epoch_counter
+        lost_at = None  # location we failed to materialize from
+        lost_attempts = 0
         while True:
             t = self._remaining(deadline)
             try:
                 value, frames = self.client.call_frames(
                     owner, "resolve",
                     {"oid": b, "wait": True, "borrower": self.address,
-                     "epoch": epoch},
+                     "epoch": epoch, "lost_at": lost_at},
                     timeout=min(t, 5.0) if t is not None else 5.0)
+                lost_at = None
             except PeerUnavailableError as e:
                 if "timed out" in str(e):
                     continue  # owner alive but object pending; keep waiting
@@ -540,14 +544,34 @@ class ClusterRuntime:
                 with self._lock:
                     self._borrowed_owner[b] = owner
                     self._borrow_epoch[b] = epoch
-                return self._materialize(b, None, value["location"],
-                                         value.get("store_name"))
+                try:
+                    return self._materialize(b, None, value["location"],
+                                             value.get("store_name"))
+                except exc.ObjectLostError:
+                    # the handed-out location is gone (node died between
+                    # task completion and this fetch). Report it to the
+                    # owner on the next resolve so the OWNER runs lineage
+                    # reconstruction (reference: ObjectRecoveryManager,
+                    # object_recovery_manager.h:38 — recovery is always
+                    # owner-driven); we then wait like any pending get.
+                    lost_attempts += 1
+                    if lost_attempts > 3:
+                        raise
+                    lost_at = value["location"]
+                    continue
             raise exc.ObjectLostError(f"{ref}: owner reports {status}")
 
     def _try_reconstruct(self, st: "_Owned") -> bool:
         """Resubmit the task whose output was lost (its spec is the
         lineage). Consumes the task's retry budget; `put()` objects have
-        no lineage and are not recoverable — same as the reference."""
+        no lineage and are not recoverable — same as the reference.
+
+        Lost ARGS are reconstructed FIRST and the task is only submitted
+        once they exist again (reference: ObjectRecoveryManager walks
+        the lineage, object_recovery_manager.h:38). Dispatching a
+        consumer whose args are still lost would park a worker slot on
+        the arg fetch — a chain deeper than the node's worker cap then
+        deadlocks the pool."""
         spec = st.spec
         if spec is None or not self.nodelet_address:
             return False
@@ -574,18 +598,73 @@ class ClusterRuntime:
                 s.has_cached = False
             spec.attempt += 1
             spec.spillback_count = 0
-        try:
-            self.client.call(self.nodelet_address, "schedule_task",
-                             {"spec": dataclass_dict(spec)}, timeout=30,
-                             retries=2)
-        except Exception:
-            for s in states:
-                if s is not None and not s.event.is_set():
-                    s.error = exc.ObjectLostError(
-                        "reconstruction submission failed")
-                    s.event.set()
-            return False
+
+        lost_args = self._reconstruct_lost_args(spec)
+
+        def submit():
+            for ast in lost_args:
+                if not ast.event.wait(timeout=120) or ast.error is not None:
+                    for s in states:
+                        if s is not None and not s.event.is_set():
+                            s.error = exc.ObjectLostError(
+                                "argument reconstruction failed")
+                            s.event.set()
+                    return
+            try:
+                self.client.call(self.nodelet_address, "schedule_task",
+                                 {"spec": dataclass_dict(spec)}, timeout=30,
+                                 retries=2)
+            except Exception:  # noqa: BLE001
+                for s in states:
+                    if s is not None and not s.event.is_set():
+                        s.error = exc.ObjectLostError(
+                            "reconstruction submission failed")
+                        s.event.set()
+
+        if lost_args:
+            # park the wait off this getter thread; the submit fires the
+            # moment the last argument is rebuilt
+            threading.Thread(target=submit, daemon=True,
+                             name="reconstruct-args").start()
+        else:
+            submit()
         return True
+
+    def _reconstruct_lost_args(self, spec: TaskSpec) -> list:
+        """Probe this task's ref args that WE own; kick reconstruction
+        for any whose bytes are gone. Returns the _Owned states to wait
+        on before (re)submitting the task."""
+        waits = []
+        for a in list(spec.args) + list(spec.kwargs.values()):
+            if not isinstance(a, RefArg) or a.owner != self.address:
+                continue
+            with self._lock:
+                ast = self._owned.get(a.oid)
+            if ast is None:
+                continue
+            if not ast.event.is_set():
+                waits.append(ast)  # already being rebuilt elsewhere
+                continue
+            if ast.error is not None or ast.inline is not None or \
+                    ast.spilled_path is not None:
+                continue  # error propagates / bytes are not on any node
+            loc = (self.nodelet_address if ast.location == "local"
+                   else ast.location)
+            if loc is None:
+                continue
+            alive = True
+            if loc != self.nodelet_address:
+                try:
+                    meta = self.client.call(loc, "object_meta",
+                                            {"oid": a.oid}, timeout=3)
+                    alive = bool(meta.get("ok"))
+                except Exception:  # noqa: BLE001
+                    alive = False
+            else:
+                alive = self.store is not None and self.store.contains(a.oid)
+            if not alive and self._try_reconstruct(ast):
+                waits.append(ast)
+        return waits
 
     def _materialize(self, oid: bytes, inline, location, store_name):
         if inline is not None:
@@ -701,12 +780,52 @@ class ClusterRuntime:
 
     # -- owner-side handlers --------------------------------------------------
 
+    def _h_list_objects(self, msg, frames):
+        """Owner-side object table for the state API (reference:
+        `ray list objects` / `ray memory` aggregate core-worker object
+        tables, python/ray/util/state/api.py:1)."""
+        out = []
+        with self._lock:
+            for b, st in self._owned.items():
+                out.append({
+                    "object_id": b.hex(),
+                    "size": st.size,
+                    "ready": st.event.is_set(),
+                    "error": st.error is not None,
+                    "inline": st.inline is not None,
+                    "location": (self.nodelet_address
+                                 if st.location == "local" else st.location),
+                    "spilled": st.spilled_path is not None,
+                    "borrowers": len(st.borrowers),
+                    "reconstructable": (st.spec is not None
+                                        and st.retries_left > 0),
+                    "owner": self.address,
+                })
+        return {"objects": out}
+
     def _h_resolve(self, msg, frames):
         b = msg["oid"]
         with self._lock:
             st = self._owned.get(b)
         if st is None:
             return {"status": "unknown"}
+        lost_at = msg.get("lost_at")
+        if lost_at is not None:
+            # a borrower failed to materialize from the location we handed
+            # out: if we'd still hand out that same location, the bytes are
+            # gone — kick owner-driven lineage reconstruction (clears the
+            # event; this resolve then parks in the pending path below)
+            with self._lock:
+                loc = (self.nodelet_address if st.location == "local"
+                       else st.location)
+                stale = (st.event.is_set() and st.error is None and
+                         st.inline is None and st.spilled_path is None and
+                         loc == lost_at)
+            if stale and not self._try_reconstruct(st):
+                return {"status": "error"}, [ser.dumps_msg(
+                    exc.ObjectLostError(
+                        f"object {b.hex()[:12]} lost at {lost_at} and not "
+                        f"reconstructable"))]
         if msg.get("wait", True):
             st.event.wait(timeout=4.5)
         if not st.event.is_set():
@@ -1024,9 +1143,35 @@ class ClusterRuntime:
                     spec.resources) or target
             if target != self.nodelet_address:
                 self._prefetch_args(target, spec)
-            self.client.call(target, "schedule_task",
-                             {"spec": dataclass_dict(spec)},
-                             timeout=60, retries=2)
+            if locality is not None and pg_id is None:
+                # the locality node may have died since the arg's location
+                # was recorded (the ownership table is not a liveness
+                # oracle). On timeout, resubmitting ELSEWHERE is only safe
+                # if the node is actually gone — schedule_task dedup is
+                # per-nodelet, so a slow-but-delivered original on a LIVE
+                # node would otherwise run twice. Probe with ping: alive ⇒
+                # retry the SAME node (its dedup absorbs duplicates);
+                # dead ⇒ it cannot run the task, local resubmit is safe.
+                try:
+                    self.client.call(target, "schedule_task",
+                                     {"spec": dataclass_dict(spec)},
+                                     timeout=10)
+                except PeerUnavailableError:
+                    alive = False
+                    try:
+                        self.client.call(target, "ping", {}, timeout=5)
+                        alive = True
+                    except Exception:  # noqa: BLE001
+                        pass
+                    retry_target = (target if alive
+                                    else self.nodelet_address)
+                    self.client.call(retry_target, "schedule_task",
+                                     {"spec": dataclass_dict(spec)},
+                                     timeout=60, retries=2)
+            else:
+                self.client.call(target, "schedule_task",
+                                 {"spec": dataclass_dict(spec)},
+                                 timeout=60, retries=2)
         refs = [ObjectRef(o, owner=self.address) for o in oids]
         if n == 0:
             return []
@@ -1191,7 +1336,8 @@ class ClusterRuntime:
             self._prefetch_args(lease.nodelet, spec)
         fut = self.client.call_async(lease.address, "execute_leased",
                                      {"spec": dataclass_dict(spec),
-                                      "attempt": spec.attempt})
+                                      "attempt": spec.attempt,
+                                      "lease_id": lease.lease_id})
 
         def resend():
             self._push_leased(lease, spec, acks_left - 1)
@@ -1202,10 +1348,32 @@ class ClusterRuntime:
             # slow-but-delivered original harmless)
             self._lease_task_failed(lease, spec)
 
+        def stale():
+            # rejected BEFORE execution (StaleLeaseError): never charge
+            # the retry budget and never resend to the dead lease
+            self._lease_task_requeue(lease, spec)
+
         with self._lock:
             self._pending_acks.append(
                 [time.monotonic() + _ack_timeout(), fut, resend,
-                 fail if acks_left <= 0 else None])
+                 fail if acks_left <= 0 else None, stale])
+
+    def _lease_task_requeue(self, lease: _HeldLease, spec: TaskSpec):
+        """A push the worker REJECTED before execution (stale lease id):
+        the task provably never ran, so re-enter it in the client-side
+        pending queue — a fresh lease picks it up on the next sweep —
+        without consuming its retry budget (that budget is for tasks
+        that may have executed)."""
+        with self._lock:
+            ent = self._task_lease.pop(spec.task_id, None)
+            if ent is None:
+                return  # completed/failed through another path meanwhile
+            lease.inflight.discard(spec.task_id)
+            lease.broken = True
+            pool = self._lease_pools.get(lease.key)
+            if pool is not None and lease in pool:
+                pool.remove(lease)
+            self._lease_pending.setdefault(lease.key, []).append(spec)
 
     def _lease_task_failed(self, lease: _HeldLease, spec: TaskSpec):
         with self._lock:
@@ -1213,6 +1381,12 @@ class ClusterRuntime:
             if ent is None:
                 return  # completed meanwhile
             lease.inflight.discard(spec.task_id)
+            # a definitive push failure (worker unreachable or stale-lease
+            # rejection) means this lease is dead: stop refilling it
+            lease.broken = True
+            pool = self._lease_pools.get(lease.key)
+            if pool is not None and lease in pool:
+                pool.remove(lease)
         self._task_failed(
             spec.return_oids,
             exc.WorkerCrashedError(
@@ -1243,14 +1417,19 @@ class ClusterRuntime:
             time.sleep(0.25)
             self._flush_deferred_sends()
             now = time.monotonic()
-            resend, fail = [], []
+            resend, fail, stale = [], [], []
             with self._lock:
                 remaining = []
                 for ent in self._pending_acks:
-                    deadline, fut, resend_fn, fail_fn = ent
+                    deadline, fut, resend_fn, fail_fn = ent[:4]
                     if fut.done() and fut.exception() is None:
                         continue  # acked
-                    if fut.done() or now > deadline:
+                    if fut.done() and len(ent) > 4 and isinstance(
+                            fut.exception(), exc.StaleLeaseError):
+                        # definitive pre-execution rejection: resending to
+                        # the same dead lease can only fail again
+                        stale.append(ent)
+                    elif fut.done() or now > deadline:
                         # failed or timed out: resend while retries remain
                         # (fail_fn is set only once retries are exhausted)
                         (fail if fail_fn is not None or resend_fn is None
@@ -1258,15 +1437,20 @@ class ClusterRuntime:
                     else:
                         remaining.append(ent)
                 self._pending_acks = remaining
-            for _, _, resend_fn, _ in resend:
+            for ent in stale:
                 try:
-                    resend_fn()
+                    ent[4]()
                 except Exception:  # noqa: BLE001
                     pass
-            for _, _, _, fail_fn in fail:
-                if fail_fn is not None:
+            for ent in resend:
+                try:
+                    ent[2]()
+                except Exception:  # noqa: BLE001
+                    pass
+            for ent in fail:
+                if ent[3] is not None:
                     try:
-                        fail_fn()
+                        ent[3]()
                     except Exception:  # noqa: BLE001
                         pass
             self._sweep_leases(now)
@@ -1322,7 +1506,9 @@ class ClusterRuntime:
                                         {"lease_id": le.lease_id})
             except Exception:  # noqa: BLE001
                 pass
-        if renew_by_nodelet and now - self._last_renew > 10.0:
+        # renew well under TTL/3 (30s TTL): renews are best-effort oneways
+        # and a couple of drops must not let a live lease expire
+        if renew_by_nodelet and now - self._last_renew > 5.0:
             self._last_renew = now
             for nodelet, ids in renew_by_nodelet.items():
                 try:
@@ -1638,11 +1824,12 @@ class ClusterRuntime:
             except Exception:
                 pass
         self._booted.clear()
-        if getattr(self, "store", None) is not None:
-            try:
-                self.store.close()
-            except Exception:
-                pass
+        # The store mapping is intentionally NOT unmapped here: late
+        # handler-pool threads (a queued free_object / resolve) and
+        # zero-copy memoryviews handed to user code may still reference
+        # the shm pages — unmapping under them is a SIGSEGV, not an
+        # exception. The name is unlinked by the nodelet that owns the
+        # segment; the pages drop with the last process mapping.
         # NOTE: the shared RpcClient is intentionally left alive — other
         # in-process services (test Cluster fixtures, a second init())
         # share it; peers to dead addresses are harmless.

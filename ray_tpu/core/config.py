@@ -33,6 +33,17 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "MAX_SPILLBACKS": (int, 4, "scheduling hops before running anywhere"),
     "PULL_CHUNK_BYTES": (int, 4 * 1024 * 1024,
                          "node-to-node object transfer chunk"),
+    # --- memory monitor / OOM killing (reference: ray_config_def.h:65
+    # memory_usage_threshold, :69 memory_monitor_refresh_ms, :97
+    # worker_killing_policy)
+    "MEMORY_USAGE_THRESHOLD": (float, 0.95,
+                               "node memory fraction before OOM killing"),
+    "MEMORY_MONITOR_REFRESH_MS": (int, 250,
+                                  "memory sampling period (0 = disabled)"),
+    "MIN_MEMORY_FREE_BYTES": (int, -1,
+                              "free-bytes floor ANDed with the threshold"),
+    "WORKER_KILLING_POLICY": (str, "group_by_owner",
+                              "group_by_owner | retriable_fifo | retriable_lifo"),
     # --- object store
     "OBJECT_STORE_BYTES": (int, 512 * 1024 * 1024, "shm store capacity"),
     "INLINE_THRESHOLD_BYTES": (int, 64 * 1024,

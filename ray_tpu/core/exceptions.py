@@ -66,3 +66,14 @@ class PlacementGroupError(RayTpuError):
 
 class RuntimeEnvSetupError(RayTpuError):
     pass
+
+
+class OutOfMemoryError(RayTpuError):
+    """A worker was killed by the node's memory monitor (reference:
+    ray.exceptions.OutOfMemoryError raised by the OOM killer)."""
+
+
+class StaleLeaseError(RayTpuError):
+    """A direct leased-task push carried a lease id the worker no longer
+    holds (TTL expiry or re-grant); the owner must resubmit through the
+    classic scheduling path."""

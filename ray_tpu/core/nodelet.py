@@ -18,6 +18,7 @@ until saturated, then best-fit remote).
 
 from __future__ import annotations
 
+import logging
 import os
 import subprocess
 import sys
@@ -32,13 +33,16 @@ from ray_tpu.core.object_store import open_store
 from ray_tpu.core.rpc import RpcClient, RpcServer
 from ray_tpu.core.specs import ActorSpec, TaskSpec
 
+_log = logging.getLogger("ray_tpu.nodelet")
+
 
 
 
 class _Worker:
     __slots__ = ("worker_id", "proc", "address", "idle", "current_task",
                  "actor_id", "ready", "acquired", "tpu", "bundle",
-                 "env_hash", "lease_id")
+                 "env_hash", "lease_id", "assigned_time", "oom_kill_retry",
+                 "oom_meta")
 
     def __init__(self, worker_id: bytes, proc, tpu: bool = False,
                  env_hash: str = ""):
@@ -47,6 +51,9 @@ class _Worker:
         self.address = None
         self.idle = False
         self.current_task = None  # TaskSpec being executed
+        self.assigned_time = 0.0  # when current work (task/lease) arrived
+        self.oom_kill_retry = None  # set by the OOM killer before SIGKILL
+        self.oom_meta = None  # (owner, retriable) for actor workers
         self.actor_id = None  # set for dedicated actor workers
         self.ready = threading.Event()
         # resources this worker currently holds (task or actor); released
@@ -155,6 +162,11 @@ class Nodelet:
         self._max_task_workers = (env_cap if env_cap else
                                   max(2, int(self.resources.get("CPU", 0) or
                                              (os.cpu_count() or 8))))
+        # spawns in flight (lease path): counted against the cap so N
+        # concurrent lease requests can't all pass the check and overshoot
+        self._pending_spawns = 0
+        self._last_memory_check = 0.0
+        self._oom_kills = 0  # observability: surfaced in node_info
 
         s = self.server
         s.register("schedule_task", self._h_schedule_task)
@@ -170,11 +182,15 @@ class Nodelet:
         s.register("prefetch_object", self._h_prefetch_object, oneway=True)
         s.register("reserve_bundle", self._h_reserve_bundle)
         s.register("release_bundle", self._h_release_bundle)
-        s.register("request_lease", self._h_request_lease)
+        # slow lane: _h_request_lease can park ~60s in spawn+ready-wait; a
+        # burst of lease requests must not starve the control-plane pool
+        s.register("request_lease", self._h_request_lease, slow=True)
         s.register("return_lease", self._h_return_lease)
         s.register("renew_leases", self._h_renew_leases, oneway=True)
         s.register("lease_demand", self._h_lease_demand, oneway=True)
         s.register("node_info", self._h_node_info)
+        # slow lane: fans out to every worker on the node
+        s.register("list_node_objects", self._h_list_node_objects, slow=True)
         s.register("list_logs", self._h_list_logs)
         s.register("tail_log", self._h_tail_log)
         s.register("ping", lambda m, f: "pong")
@@ -229,7 +245,13 @@ class Nodelet:
                 except Exception:
                     pass
         self.server.stop()
-        self.store.close()
+        # Unlink the shm NAME but keep this process's mapping alive:
+        # server.stop() does not drain in-flight handler threads (slow-
+        # lane handlers can park for seconds), so a queued free_object /
+        # fetch_object may still touch the store — unmapping under it is
+        # a process SIGSEGV (observed in the r4 soak). Pages are freed
+        # when the last mapping drops (process exit for in-process test
+        # nodelets; 64MB-class test segments make that affordable).
         self.store.unlink()
 
     # ------------------------------------------------------------ logs
@@ -434,8 +456,30 @@ class Nodelet:
             if w is None:
                 n_task_workers = sum(1 for x in self._workers.values()
                                      if x.actor_id is None)
-                if n_task_workers >= self._max_task_workers:
-                    return {"granted": False, "reason": "worker-cap"}
+                if n_task_workers + self._pending_spawns >= \
+                        self._max_task_workers:
+                    # capped: any idle worker has the wrong env/device
+                    # shape — evict one to make room (same policy as the
+                    # classic dispatch path; reference: runtime-env-keyed
+                    # worker eviction, worker_pool.h). If all are busy,
+                    # refuse and let the submitter back off.
+                    victim = None
+                    for cand in list(self._idle_workers):
+                        if cand.worker_id in self._workers:
+                            victim = cand
+                            self._idle_workers.remove(cand)
+                            victim.idle = False  # reap loop polls it
+                            break
+                    if victim is None:
+                        return {"granted": False, "reason": "worker-cap"}
+                    try:
+                        victim.proc.terminate()
+                    except Exception:  # noqa: BLE001
+                        pass
+                # reserve the pool slot inside THIS lock hold: the worker
+                # only appears in _workers after the spawn completes, so
+                # racing requests would all pass the cap check otherwise
+                self._pending_spawns += 1
             # acquire before the (slow) spawn so racing submitters spill
             for r, q in resources.items():
                 self._available[r] = _fpq(self._available[r] - q)
@@ -452,15 +496,41 @@ class Nodelet:
                 w = self._spawn_worker(tpu=needs_tpu, runtime_env=runtime_env,
                                        lease_id=lease_id)
             except Exception as e:  # noqa: BLE001
+                with self._lock:
+                    self._pending_spawns -= 1
                 _rollback()
                 return {"granted": False, "reason": f"spawn failed: {e}"}
-        if not w.ready.wait(timeout=60):
+            with self._lock:
+                self._pending_spawns -= 1
+        def _ungrant():
+            # the worker stays in the pool: put it back on the idle list
+            # (a reused worker gets no second worker_ready, so without
+            # this it would leak a pool slot forever — capped refusals
+            # with zero running work)
             with self._lock:
                 w.lease_id = None
+                if w.worker_id in self._workers and w.actor_id is None \
+                        and w.current_task is None and not w.idle \
+                        and w.ready.is_set():
+                    w.idle = True
+                    self._idle_workers.append(w)
             _rollback()
+            self._dispatch_wake.set()
+
+        if not w.ready.wait(timeout=60):
+            _ungrant()
             return {"granted": False, "reason": "worker-start-timeout"}
+        # tell the worker its live lease id BEFORE the grant returns, so
+        # it can reject direct pushes carrying a stale/expired lease
+        try:
+            self.client.call(w.address, "set_lease",
+                             {"lease_id": lease_id}, timeout=10)
+        except Exception:  # noqa: BLE001
+            _ungrant()
+            return {"granted": False, "reason": "worker-unreachable"}
         with self._lock:
             w.acquired = dict(resources)
+            w.assigned_time = time.monotonic()
             self._leases[lease_id] = _Lease(
                 lease_id, w, msg.get("owner"), resources,
                 time.monotonic() + LEASE_TTL_S)
@@ -479,7 +549,8 @@ class Nodelet:
                 if lease is not None:
                     lease.expiry = now + LEASE_TTL_S
 
-    def _end_lease(self, lease_id: bytes, back_to_idle: bool):
+    def _end_lease(self, lease_id: bytes, back_to_idle: bool,
+                   notify_owner: bool = False, reason: str = ""):
         with self._lock:
             lease = self._leases.pop(lease_id, None)
         if lease is None:
@@ -487,6 +558,28 @@ class Nodelet:
         w = lease.worker
         with self._lock:
             w.lease_id = None
+            addr = w.address
+        # tell the worker the lease died so it rejects stale direct pushes
+        # (keyed clear: a racing re-grant's set_lease is never clobbered)
+        if addr:
+            try:
+                self.client.send_oneway(addr, "set_lease",
+                                        {"clear": lease_id})
+            except Exception:  # noqa: BLE001
+                pass
+        # TTL expiry means the owner stopped renewing OR its renew oneways
+        # were lost; in the second case it still believes the lease is live
+        # and its enqueue-acked in-flight pushes would hang forever without
+        # this notification (they are past ack-sweeper coverage)
+        if notify_owner and lease.owner:
+            try:
+                self.client.send_oneway(lease.owner, "lease_broken", {
+                    "lease_id": lease_id,
+                    "worker_id": w.worker_id,
+                    "reason": reason,
+                })
+            except Exception:  # noqa: BLE001
+                pass
         self._release_worker_resources(w)
         if back_to_idle:
             with self._lock:
@@ -502,7 +595,8 @@ class Nodelet:
             stale = [lid for lid, le in self._leases.items()
                      if le.expiry < now]
         for lid in stale:
-            self._end_lease(lid, back_to_idle=True)
+            self._end_lease(lid, back_to_idle=True, notify_owner=True,
+                            reason="lease TTL expired")
 
     def _reap_loop(self):
         """Detect worker-process death (reference: raylet learns of worker
@@ -520,6 +614,71 @@ class Nodelet:
             for w in dead:
                 self._on_worker_death(w)
             self._expire_leases()
+            self._check_memory_pressure()
+
+    # ------------------------------------------------------------ OOM killer
+    # Reference: memory_monitor.h:52 node-RSS sampling + the shipped
+    # worker-killing policies (worker_killing_policy.h:34). Without this
+    # a host-RAM-hungry job takes the whole nodelet (and node) with it.
+
+    def _check_memory_pressure(self):
+        from ray_tpu.core import oom
+
+        refresh_ms = cfg.get("MEMORY_MONITOR_REFRESH_MS")
+        if refresh_ms <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_memory_check < refresh_ms / 1000.0:
+            return
+        self._last_memory_check = now
+        snap = oom.take_snapshot()
+        if not oom.is_above_threshold(snap, cfg.get("MEMORY_USAGE_THRESHOLD"),
+                                      cfg.get("MIN_MEMORY_FREE_BYTES")):
+            return
+        candidates = []
+        with self._lock:
+            lease_by_worker = {le.worker.worker_id: le
+                               for le in self._leases.values()}
+            for w in self._workers.values():
+                if w.oom_kill_retry is not None:
+                    return  # a kill is already in flight; wait for reap
+                cand = None
+                if w.current_task is not None:
+                    spec = w.current_task
+                    cand = oom.KillCandidate(
+                        w, spec.owner, spec.max_retries != 0,
+                        w.assigned_time)
+                elif w.worker_id in lease_by_worker:
+                    le = lease_by_worker[w.worker_id]
+                    # leased pushes are owner-resubmitted via lease_broken
+                    cand = oom.KillCandidate(w, le.owner or "", True,
+                                             w.assigned_time)
+                elif w.actor_id is not None and w.oom_meta is not None:
+                    owner, restartable = w.oom_meta
+                    cand = oom.KillCandidate(w, owner, restartable,
+                                             w.assigned_time)
+                if cand is not None:
+                    cand.rss_bytes = oom.process_rss_bytes(w.proc.pid)
+                    candidates.append(cand)
+        victim, should_retry = oom.select_worker_to_kill(
+            candidates, cfg.get("WORKER_KILLING_POLICY"))
+        if victim is None:
+            return
+        w = victim.worker
+        with self._lock:
+            w.oom_kill_retry = bool(should_retry)
+        self._oom_kills += 1
+        _log.warning(
+            "memory pressure: %.1f%% used (threshold %.0f%%); killing "
+            "worker %s (rss=%dMB, policy=%s, retry=%s)",
+            snap.used_fraction * 100,
+            cfg.get("MEMORY_USAGE_THRESHOLD") * 100,
+            w.worker_id.hex()[:8], victim.rss_bytes >> 20,
+            cfg.get("WORKER_KILLING_POLICY"), should_retry)
+        try:
+            w.proc.kill()
+        except Exception:  # noqa: BLE001
+            pass
 
     def _on_worker_death(self, w: _Worker):
         rc = w.proc.returncode
@@ -529,21 +688,29 @@ class Nodelet:
         # the owner resubmits twice and the task runs twice
         with self._lock:
             spec, w.current_task = w.current_task, None
+            oom_retry = w.oom_kill_retry
         if spec is not None:
+            if oom_retry is not None:
+                err, retryable = _oom_killed_error(spec.name), bool(oom_retry)
+            else:
+                err, retryable = _worker_died_error(spec.name, rc), True
             try:
                 self.client.send_oneway(spec.owner, "task_done", {
                     "task_id": spec.task_id,
                     "oids": spec.return_oids,
-                    "error": ser.dumps_msg(_worker_died_error(spec.name, rc)),
-                    "retryable": True,
+                    "error": ser.dumps_msg(err),
+                    "retryable": retryable,
                 })
             except Exception:
                 pass
         if w.actor_id is not None and not self._stopped.is_set():
+            cause = ("killed by the node memory monitor (OOM)"
+                     if oom_retry is not None
+                     else f"worker process exited (code {rc})")
             try:
                 self.client.call(self.head_address, "actor_died",
                                  {"actor_id": w.actor_id,
-                                  "cause": f"worker process exited (code {rc})"},
+                                  "cause": cause},
                                  timeout=10)
             except Exception:
                 pass
@@ -908,6 +1075,7 @@ class Nodelet:
                         w.bundle = (bundle_key, dict(spec.resources))
                 w.idle = False
                 w.current_task = spec
+                w.assigned_time = time.monotonic()
                 threading.Thread(target=self._push_task, args=(w, spec),
                                  daemon=True).start()
 
@@ -1003,6 +1171,9 @@ class Nodelet:
             with self._lock:
                 w.bundle = (bundle_key, dict(spec.resources))
         w.actor_id = spec.actor_id
+        w.assigned_time = time.monotonic()
+        # OOM group-by-owner key + restartability for the kill policy
+        w.oom_meta = (spec.owner, spec.max_restarts != 0)
 
         def push():
             if not w.ready.wait(timeout=60):
@@ -1214,7 +1385,30 @@ class Nodelet:
             return {"node_id": self.node_id, "address": self.address,
                     "store_name": self.store.name, "resources": self.resources,
                     "available": dict(self._available), "labels": self.labels,
-                    "num_workers": len(self._workers)}
+                    "num_workers": len(self._workers),
+                    "oom_kills": self._oom_kills}
+
+    def _h_list_node_objects(self, msg, frames):
+        """Aggregate this node's owner-side object tables + store stats
+        (reference: the raylet answers `ray memory` for its workers by
+        fanning out to their core workers)."""
+        with self._lock:
+            addrs = [w.address for w in self._workers.values()
+                     if w.address and w.ready.is_set()]
+        objects = []
+        for a in addrs:
+            try:
+                r = self.client.call(a, "list_objects", {}, timeout=5)
+                objects.extend(r.get("objects", ()))
+            except Exception:  # noqa: BLE001
+                pass  # worker mid-exit
+        try:
+            store = self.store.stats()
+        except Exception:  # noqa: BLE001
+            store = {}
+        return {"objects": objects, "store": store,
+                "node_id": self.node_id, "address": self.address,
+                "oom_kills": self._oom_kills}
 
 
 def _worker_died_error(name: str, code):
@@ -1222,6 +1416,14 @@ def _worker_died_error(name: str, code):
 
     return exc.WorkerCrashedError(
         f"worker executing {name!r} died unexpectedly (exit code {code})")
+
+
+def _oom_killed_error(name: str):
+    from ray_tpu.core import exceptions as exc
+
+    return exc.OutOfMemoryError(
+        f"worker executing {name!r} was killed by the node memory monitor "
+        f"to relieve memory pressure (reference: OOM killer semantics)")
 
 
 def main():

@@ -1,0 +1,148 @@
+"""Node memory monitor + OOM worker-killing policies.
+
+Reference parity: src/ray/common/memory_monitor.h:52 (threshold +
+min-free sampling of /proc) and src/ray/raylet/worker_killing_policy.h:34
+with its two shipped policies — worker_killing_policy_group_by_owner.cc
+(groups retriable tasks by owner; kills from the retriable/largest/
+newest group, LIFO inside the group; retries unless the group is down
+to its last member) and worker_killing_policy_retriable_fifo.cc
+(retriable first, earliest-assigned first).
+
+The monitor is pure-Python over /proc (no psutil dependency); tests
+inject usage via RAY_TPU_TEST_MEMORY_{USED,TOTAL}_BYTES env overrides,
+mirroring how the reference's tests inject MemorySnapshot.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+_PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+@dataclass
+class MemorySnapshot:
+    used_bytes: int
+    total_bytes: int
+    process_rss: dict[int, int] = field(default_factory=dict)  # pid -> rss
+
+    @property
+    def used_fraction(self) -> float:
+        return self.used_bytes / self.total_bytes if self.total_bytes else 0.0
+
+
+def _meminfo() -> tuple[int, int]:
+    """(used, total) from /proc/meminfo; used = total - MemAvailable."""
+    total = avail = 0
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1]) * 1024
+                elif line.startswith("MemAvailable:"):
+                    avail = int(line.split()[1]) * 1024
+                if total and avail:
+                    break
+    except OSError:
+        return 0, 0
+    return max(0, total - avail), total
+
+
+def process_rss_bytes(pid: int) -> int:
+    try:
+        with open(f"/proc/{pid}/statm") as f:
+            return int(f.read().split()[1]) * _PAGE
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def take_snapshot(pids: list[int] = ()) -> MemorySnapshot:
+    """Current node memory usage. Test seams (reference: MemoryMonitor
+    unit tests construct MemorySnapshot directly):
+    RAY_TPU_TEST_MEMORY_USED_BYTES / RAY_TPU_TEST_MEMORY_TOTAL_BYTES."""
+    fake_used = os.environ.get("RAY_TPU_TEST_MEMORY_USED_BYTES")
+    fake_total = os.environ.get("RAY_TPU_TEST_MEMORY_TOTAL_BYTES")
+    if fake_used is not None or fake_total is not None:
+        used = int(fake_used or 0)
+        total = int(fake_total or 0) or (1 << 40)
+    else:
+        used, total = _meminfo()
+    return MemorySnapshot(used, total,
+                          {pid: process_rss_bytes(pid) for pid in pids})
+
+
+def is_above_threshold(snap: MemorySnapshot, usage_threshold: float,
+                       min_memory_free_bytes: int) -> bool:
+    """Reference semantics (memory_monitor.cc): over the fractional
+    threshold, AND — when min_memory_free_bytes >= 0 — free space is
+    also below that floor (the floor relaxes the fraction on huge
+    hosts)."""
+    if snap.total_bytes <= 0:
+        return False
+    over_fraction = snap.used_fraction > usage_threshold
+    if min_memory_free_bytes >= 0:
+        free = snap.total_bytes - snap.used_bytes
+        return over_fraction and free < min_memory_free_bytes
+    return over_fraction
+
+
+# ---------------------------------------------------------------- policies
+
+
+@dataclass
+class KillCandidate:
+    """One killable worker as the policy sees it."""
+
+    worker: Any  # opaque handle returned to the caller
+    owner: str  # submitting owner identity (group key)
+    retriable: bool
+    assigned_time: float  # monotonic time the current work was assigned
+    rss_bytes: int = 0
+
+
+GROUP_BY_OWNER = "group_by_owner"
+RETRIABLE_FIFO = "retriable_fifo"
+RETRIABLE_LIFO = "retriable_lifo"
+
+
+def select_worker_to_kill(candidates: list[KillCandidate],
+                          policy: str) -> tuple[KillCandidate | None, bool]:
+    """Pick the victim and whether its task should be retried."""
+    if not candidates:
+        return None, False
+    if policy == GROUP_BY_OWNER:
+        return _group_by_owner(candidates)
+    if policy == RETRIABLE_LIFO:
+        c = sorted(candidates,
+                   key=lambda c: (0 if c.retriable else 1, -c.assigned_time))[0]
+        return c, True
+    # default: retriable_fifo — retriable first, earliest-assigned first
+    c = sorted(candidates,
+               key=lambda c: (0 if c.retriable else 1, c.assigned_time))[0]
+    return c, True
+
+
+def _group_by_owner(candidates: list[KillCandidate]):
+    """All non-retriable work shares ONE group (key None); retriable work
+    groups by owner. Prefer killing from a retriable group, then the
+    largest, then the newest (by its earliest assignment); LIFO victim
+    inside the group; retry unless the group is down to its last member
+    (reference: worker_killing_policy_group_by_owner.cc:56-77)."""
+    groups: dict[Any, list[KillCandidate]] = {}
+    for c in candidates:
+        groups.setdefault(c.owner if c.retriable else None, []).append(c)
+
+    def rank(item):
+        key, members = item
+        retriable = members[0].retriable
+        earliest = min(m.assigned_time for m in members)
+        return (0 if retriable else 1, -len(members), -earliest)
+
+    _, members = sorted(groups.items(), key=rank)[0]
+    retriable = members[0].retriable
+    should_retry = retriable and len(members) > 1
+    victim = max(members, key=lambda m: m.assigned_time)  # LIFO
+    return victim, should_retry

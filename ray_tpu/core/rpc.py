@@ -20,6 +20,7 @@ first N sends of `method`, exercising retry paths deterministically.
 
 from __future__ import annotations
 
+import collections
 import os
 import pickle
 import struct
@@ -86,27 +87,138 @@ def _chaos_should_drop(method: str) -> bool:
     return False
 
 
-# ---------------------------------------------------------------- server
+# ------------------------------------------------------ socket ownership
 
-def _send_nonblocking(sock, lock, parts, timeout: float = 10.0):
-    """Send under `lock` WITHOUT parking the lock on a full/disconnected
-    peer: NOBLOCK attempts with short sleeps between tries, so the recv
-    loop (which shares the lock) keeps draining replies while this
-    sender waits for HWM space."""
-    deadline = time.monotonic() + timeout
-    sleep = 1e-4
-    while True:
+
+class _SocketOwner:
+    """Single-thread owner of a zmq socket (the standard pyzmq pattern).
+
+    libzmq sockets are not thread-safe: any two threads touching one
+    socket concurrently — even recv vs send — can trip the fatal
+    `mailbox.cpp` assertion and abort the process. So every socket here
+    is driven by exactly one thread, which performs ALL socket
+    operations (connect-side sends, binds-side replies, and recvs).
+    Other threads enqueue outbound multiparts onto a deque and wake the
+    owner by writing a byte to an OS pipe (pipe writes are async-signal
+    and thread safe); the owner polls the socket and the pipe together.
+
+    Backpressure: when the socket's send HWM is hit the head-of-line
+    message waits for POLLOUT while later messages queue behind it, up
+    to _MAX_QUEUE messages AND _MAX_QUEUE_BYTES of payload (a stalled
+    peer receiving 4MB object chunks must bound MEMORY, not just
+    message count), after which send() raises PeerUnavailableError.
+
+    Reference parity: the reliability role of rpc/retryable_grpc_client.h
+    (the reference leans on grpc's own event loop for this).
+    """
+
+    _MAX_QUEUE = 65536
+    _MAX_QUEUE_BYTES = 256 * 1024 * 1024
+
+    def __init__(self, sock, name: str, on_recv):
+        self._sock = sock
+        self._on_recv = on_recv
+        self._sendq: collections.deque = collections.deque()
+        self._sendq_bytes = 0
+        self._sendq_lock = threading.Lock()
+        self._wake_r, self._wake_w = os.pipe()
+        os.set_blocking(self._wake_w, False)
+        # guards the wake-pipe write against fd close/reuse at teardown
+        self._wake_lock = threading.Lock()
+        self._wake_closed = False
+        self._stopped = threading.Event()
+        self._closed = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=name)
+        self._thread.start()
+
+    def send(self, parts: list):
+        if self._stopped.is_set():
+            raise PeerUnavailableError("socket closed")
+        nbytes = sum(len(p) for p in parts)
+        with self._sendq_lock:
+            if len(self._sendq) >= self._MAX_QUEUE or \
+                    self._sendq_bytes + nbytes > self._MAX_QUEUE_BYTES:
+                raise PeerUnavailableError("send queue full")
+            self._sendq.append(parts)
+            self._sendq_bytes += nbytes
+        self._wake()
+
+    def _wake(self):
+        with self._wake_lock:
+            if self._wake_closed:
+                return
+            try:
+                os.write(self._wake_w, b"\x01")
+            except (BlockingIOError, OSError):
+                pass  # pipe full ⇒ the owner already has a wake pending
+
+    def _loop(self):
+        poller = zmq.Poller()
+        poller.register(self._wake_r, zmq.POLLIN)
+        pending = None  # head-of-line multipart blocked on HWM
         try:
-            with lock:
-                sock.send_multipart(parts, flags=zmq.NOBLOCK)
-            return
-        except zmq.Again:
-            if time.monotonic() > deadline:
-                raise PeerUnavailableError("send queue full (HWM)") from None
-            time.sleep(sleep)
-            sleep = min(sleep * 2, 0.01)
+            while True:
+                want_out = pending is not None or bool(self._sendq)
+                poller.register(
+                    self._sock,
+                    zmq.POLLIN | (zmq.POLLOUT if want_out else 0))
+                events = dict(poller.poll(timeout=100))
+                if self._stopped.is_set():
+                    break
+                if events.get(self._wake_r):
+                    try:
+                        os.read(self._wake_r, 4096)
+                    except OSError:
+                        pass
+                # inbound first so a send backlog can't starve replies
+                if events.get(self._sock, 0) & zmq.POLLIN:
+                    for _ in range(128):  # bounded burst, then re-poll
+                        try:
+                            parts = self._sock.recv_multipart(zmq.NOBLOCK)
+                        except zmq.Again:
+                            break
+                        except zmq.ZMQError:
+                            self._stopped.set()
+                            break
+                        try:
+                            self._on_recv(parts)
+                        except Exception:  # noqa: BLE001
+                            pass
+                while pending is not None or self._sendq:
+                    if pending is None:
+                        with self._sendq_lock:
+                            pending = self._sendq.popleft()
+                            self._sendq_bytes -= sum(len(p) for p in pending)
+                    try:
+                        self._sock.send_multipart(pending, flags=zmq.NOBLOCK)
+                        pending = None
+                    except zmq.Again:
+                        break  # HWM: wait for POLLOUT
+                    except zmq.ZMQError:
+                        pending = None  # peer gone: drop, retry layer covers
+        finally:
+            # the owner thread closes its own socket — never another thread
+            try:
+                self._sock.close(0)
+            except Exception:  # noqa: BLE001
+                pass
+            with self._wake_lock:
+                self._wake_closed = True
+                try:
+                    os.close(self._wake_r)
+                    os.close(self._wake_w)
+                except OSError:
+                    pass
+            self._closed.set()
+
+    def stop(self, timeout: float = 2.0):
+        self._stopped.set()
+        self._wake()
+        self._closed.wait(timeout)
 
 
+# ---------------------------------------------------------------- server
 
 
 def node_ip() -> str:
@@ -164,45 +276,32 @@ class RpcServer:
         # pubsub vs control RPCs)
         self._slow_pool = ThreadPoolExecutor(max_workers=num_threads,
                                              thread_name_prefix=f"{name}-s")
-        self._send_lock = threading.Lock()
-        self._stopped = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name=f"{name}-recv")
+        self._name = name
+        self._owner: _SocketOwner | None = None
 
     def register(self, method: str, fn, oneway: bool = False,
                  slow: bool = False):
         self._handlers[method] = (fn, oneway, slow)
 
     def start(self):
-        self._thread.start()
+        self._owner = _SocketOwner(self._sock, f"{self._name}-io",
+                                   self._on_recv)
         return self
 
-    def _loop(self):
-        poller = zmq.Poller()
-        poller.register(self._sock, zmq.POLLIN)
-        while not self._stopped.is_set():
-            if not dict(poller.poll(timeout=100)):
-                continue
-            try:
-                # share the reply-send lock: concurrent recv+send on one
-                # zmq socket can abort libzmq (mailbox assertion)
-                with self._send_lock:
-                    parts = self._sock.recv_multipart(zmq.NOBLOCK)
-            except zmq.Again:
-                continue
-            if len(parts) < 4:
-                continue
-            ident, msg_id, method_b, payload = parts[0], parts[1], parts[2], parts[3]
-            frames = [bytes(f) for f in parts[4:]]
-            method = method_b.decode()
-            entry = self._handlers.get(method)
-            pool = (self._slow_pool if entry is not None and entry[2]
-                    else self._pool)
-            try:
-                pool.submit(self._dispatch, ident, msg_id, method,
-                            payload, frames)
-            except RuntimeError:
-                return  # pool shut down mid-teardown: stop receiving
+    def _on_recv(self, parts):
+        if len(parts) < 4:
+            return
+        ident, msg_id, method_b, payload = parts[0], parts[1], parts[2], parts[3]
+        frames = [bytes(f) for f in parts[4:]]
+        method = method_b.decode()
+        entry = self._handlers.get(method)
+        pool = (self._slow_pool if entry is not None and entry[2]
+                else self._pool)
+        try:
+            pool.submit(self._dispatch, ident, msg_id, method,
+                        payload, frames)
+        except RuntimeError:
+            pass  # pool shut down mid-teardown: drop
 
     def _dispatch(self, ident, msg_id, method, payload, frames):
         entry = self._handlers.get(method)
@@ -231,20 +330,20 @@ class RpcServer:
 
     def _reply(self, ident, msg_id, status, payload, frames=()):
         try:
-            _send_nonblocking(self._sock, self._send_lock,
-                              [ident, msg_id, status, payload, *frames])
-        except (zmq.ZMQError, PeerUnavailableError):
-            pass  # peer gone / queue full
+            self._owner.send([ident, msg_id, status, payload, *frames])
+        except (zmq.ZMQError, PeerUnavailableError, AttributeError):
+            pass  # peer gone / queue full / never started
 
     def stop(self):
-        self._stopped.set()
-        self._thread.join(timeout=2)
+        if self._owner is not None:
+            self._owner.stop()
+        else:
+            try:
+                self._sock.close(0)
+            except Exception:
+                pass
         self._pool.shutdown(wait=False)
         self._slow_pool.shutdown(wait=False)
-        try:
-            self._sock.close(0)
-        except Exception:
-            pass
 
 
 # ---------------------------------------------------------------- client
@@ -252,63 +351,44 @@ class RpcServer:
 
 class _Peer:
     def __init__(self, address: str):
-        self._ctx = zmq.Context.instance()
-        self.sock = self._ctx.socket(zmq.DEALER)
-        self.sock.setsockopt(zmq.LINGER, 0)
-        self.sock.connect(f"tcp://{address}")
+        ctx = zmq.Context.instance()
+        sock = ctx.socket(zmq.DEALER)
+        sock.setsockopt(zmq.LINGER, 0)
+        sock.connect(f"tcp://{address}")
         self.address = address
-        self.send_lock = threading.Lock()
         self.pending: dict[bytes, Future] = {}
         self.pending_lock = threading.Lock()
-        self.recv_thread = threading.Thread(target=self._recv_loop, daemon=True,
-                                            name=f"rpc-cli-{address}")
-        self.stopped = threading.Event()
-        self.recv_thread.start()
+        # the socket is handed to its owner thread here and never touched
+        # by any other thread again (thread start = full memory fence)
+        self.owner = _SocketOwner(sock, f"rpc-cli-{address}", self._on_recv)
 
-    def _recv_loop(self):
-        poller = zmq.Poller()
-        poller.register(self.sock, zmq.POLLIN)
-        while not self.stopped.is_set():
-            if not dict(poller.poll(timeout=100)):
-                continue
+    def _on_recv(self, parts):
+        if len(parts) < 3:
+            return
+        msg_id, status, payload = parts[0], parts[1], parts[2]
+        frames = [bytes(f) for f in parts[3:]]
+        with self.pending_lock:
+            fut = self.pending.pop(bytes(msg_id), None)
+        if fut is None:
+            return
+        if status == _OK:
+            fut.set_result((ser.loads_msg(payload) if payload else None, frames))
+        else:
             try:
-                # zmq sockets are not thread-safe: the non-blocking recv
-                # shares the send lock so it can never interleave with a
-                # concurrent send's socket operations (libzmq aborts with
-                # a mailbox assertion otherwise)
-                with self.send_lock:
-                    parts = self.sock.recv_multipart(zmq.NOBLOCK)
-            except zmq.Again:
-                continue
-            except zmq.ZMQError:
-                return
-            if len(parts) < 3:
-                continue
-            msg_id, status, payload = parts[0], parts[1], parts[2]
-            frames = [bytes(f) for f in parts[3:]]
-            with self.pending_lock:
-                fut = self.pending.pop(bytes(msg_id), None)
-            if fut is None:
-                continue
-            if status == _OK:
-                fut.set_result((ser.loads_msg(payload) if payload else None, frames))
-            else:
-                try:
-                    fut.set_exception(ser.loads_msg(payload))
-                except Exception:
-                    fut.set_exception(RpcError("remote error (undecodable)"))
+                fut.set_exception(ser.loads_msg(payload))
+            except Exception:
+                fut.set_exception(RpcError("remote error (undecodable)"))
+
+    def send(self, parts):
+        self.owner.send(parts)
 
     def close(self):
-        self.stopped.set()
+        self.owner.stop()
         with self.pending_lock:
             for fut in self.pending.values():
                 if not fut.done():
                     fut.set_exception(PeerUnavailableError(self.address))
             self.pending.clear()
-        try:
-            self.sock.close(0)
-        except Exception:
-            pass
 
 
 class RpcClient:
@@ -337,11 +417,20 @@ class RpcClient:
                 cls._instance = None
 
     def _peer(self, address: str) -> _Peer:
+        stale = None
         with self._lock:
             p = self._peers.get(address)
+            if p is not None and p.owner._stopped.is_set():
+                # the owner thread died (transient ZMQError closed the
+                # socket): recreate the peer instead of poisoning every
+                # future call to a possibly-healthy address
+                stale, p = p, None
+                self._peers.pop(address, None)
             if p is None:
                 p = self._peers[address] = _Peer(address)
-            return p
+        if stale is not None:
+            stale.close()  # fail its pending futures
+        return p
 
     def _next_id(self) -> bytes:
         with self._lock:
@@ -362,8 +451,13 @@ class RpcClient:
         if _chaos_should_drop(method):
             return msg_id, fut  # simulated drop: caller's timeout/retry fires
         payload = ser.dumps_msg(msg or {})
-        _send_nonblocking(peer.sock, peer.send_lock,
-                          [msg_id, method.encode(), payload, *frames])
+        try:
+            peer.send([msg_id, method.encode(), payload, *frames])
+        except PeerUnavailableError:
+            with peer.pending_lock:
+                fut2 = peer.pending.pop(msg_id, None)
+            if fut2 is not None and not fut2.done():
+                fut2.set_exception(PeerUnavailableError(peer.address))
         return msg_id, fut
 
     def call(self, address: str, method: str, msg: dict | None = None,
@@ -404,8 +498,10 @@ class RpcClient:
         if _chaos_should_drop(method):
             return
         payload = ser.dumps_msg(msg or {})
-        _send_nonblocking(peer.sock, peer.send_lock,
-                          [b"\x00" * 8, method.encode(), payload, *frames])
+        try:
+            peer.send([b"\x00" * 8, method.encode(), payload, *frames])
+        except PeerUnavailableError:
+            pass  # oneways are best-effort by contract
 
     def drop_peer(self, address: str):
         with self._lock:

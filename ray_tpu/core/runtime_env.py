@@ -1,11 +1,21 @@
-"""Runtime environments for tasks/actors.
+"""Runtime environments for tasks/actors — plugin architecture.
 
-Reference parity: python/ray/_private/runtime_env/ — per-task/actor
-environments materialized on the node BEFORE the worker starts
-(working_dir.py: zipped dirs shipped via GCS and extracted per node;
-plugin env_vars). Scope: env_vars + working_dir (the two the reference
-lists first); pip/conda isolation is out of scope in this image (no
-installs allowed) and gated with a clear error."""
+Reference parity: python/ray/_private/runtime_env/plugin.py:1 (the
+RuntimeEnvPlugin ABC with validate/create/modify-context lifecycle and
+priority ordering), working_dir.py (zipped dirs shipped via GCS,
+content-addressed, extracted per node), py_modules.py:1 (extra
+importable modules distributed the same way and prepended to the
+worker's import path), and the env_vars plugin.
+
+Redesign: one registry of `RuntimeEnvPlugin`s keyed by their
+runtime_env field. The driver runs `validate` + `upload` (makes the
+value shippable: blobs go to the head KV once, content-addressed); the
+node runs `materialize`, which mutates a `RuntimeEnvContext` (process
+env, import paths, cwd) the nodelet applies when spawning the worker.
+pip/uv/conda keep their reference names but are gated with a clear
+error — this image forbids installs — so the seam exists for them to
+land in later (reference: uv.py, pip.py).
+"""
 
 from __future__ import annotations
 
@@ -14,13 +24,13 @@ import io
 import json
 import os
 import zipfile
+from dataclasses import dataclass, field
 
-_SUPPORTED = {"env_vars", "working_dir"}
 _SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
 _MAX_WORKING_DIR_BYTES = 256 * 1024 * 1024
 
 
-def _zip_dir(path: str) -> bytes:
+def _zip_dir(path: str, prefix: str = "") -> bytes:
     buf = io.BytesIO()
     total = 0
     with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
@@ -28,11 +38,11 @@ def _zip_dir(path: str) -> bytes:
             dirs[:] = [d for d in dirs if d not in _SKIP_DIRS]
             for f in files:
                 full = os.path.join(root, f)
-                rel = os.path.relpath(full, path)
+                rel = os.path.join(prefix, os.path.relpath(full, path))
                 total += os.path.getsize(full)
                 if total > _MAX_WORKING_DIR_BYTES:
                     raise ValueError(
-                        f"working_dir {path} exceeds "
+                        f"directory {path} exceeds "
                         f"{_MAX_WORKING_DIR_BYTES} bytes")
                 z.write(full, rel)
     return buf.getvalue()
@@ -56,32 +66,252 @@ def dir_fingerprint(path: str) -> str:
     return h.hexdigest()
 
 
+def _upload_blob(blob: bytes, client, head_address: str) -> str:
+    key = hashlib.sha1(blob).hexdigest()
+    client.call(head_address, "kv_put",
+                {"ns": "rtenv", "key": key, "overwrite": False},
+                frames=[blob], timeout=60, retries=2)
+    return key
+
+
+def _fetch_extract(key: str, session_dir: str, client,
+                   head_address: str) -> str:
+    """Content-addressed, idempotent extraction of a KV blob; safe under
+    concurrent materialization by multiple workers on one node."""
+    dest = os.path.join(session_dir, "runtime_envs", key)
+    done = os.path.join(dest, ".ready")
+    if not os.path.exists(done):
+        value, frames = client.call_frames(
+            head_address, "kv_get", {"ns": "rtenv", "key": key},
+            timeout=60, retries=2)
+        if not value.get("found"):
+            raise RuntimeError(f"runtime_env blob {key} not in head KV")
+        tmp = dest + f".tmp.{os.getpid()}"
+        os.makedirs(tmp, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(frames[0])) as z:
+            z.extractall(tmp)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        try:
+            os.rename(tmp, dest)
+        except OSError:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)  # concurrent winner
+        with open(done, "w") as f:
+            f.write("ok")
+    return dest
+
+
+# ---------------------------------------------------------------- context
+
+
+@dataclass
+class RuntimeEnvContext:
+    """What materialized plugins contribute to the worker process
+    (reference: runtime_env/context.py RuntimeEnvContext)."""
+
+    env: dict[str, str] = field(default_factory=dict)
+    py_paths: list[str] = field(default_factory=list)  # PYTHONPATH prepends
+    cwd: str | None = None
+
+
+# ---------------------------------------------------------------- plugins
+
+
+class RuntimeEnvPlugin:
+    """One runtime_env field's lifecycle (reference: plugin.py:1).
+
+    validate  — driver side; raise on malformed input, return the
+                canonical value.
+    upload    — driver side; replace local paths with content-addressed
+                KV keys so the value is shippable.
+    materialize — node side; fetch/extract and mutate the context.
+    Lower `priority` materializes earlier (reference: plugin priority
+    ordering), so later plugins can see earlier ones' contributions.
+    """
+
+    name: str = ""
+    priority: int = 10
+
+    def validate(self, value):
+        return value
+
+    def upload(self, value, client, head_address: str):
+        return value
+
+    def materialize(self, value, ctx: RuntimeEnvContext, session_dir: str,
+                    client, head_address: str) -> None:
+        pass
+
+
+class EnvVarsPlugin(RuntimeEnvPlugin):
+    name = "env_vars"
+    priority = 0
+
+    def validate(self, value):
+        if not isinstance(value, dict):
+            raise ValueError("env_vars must be a dict of str -> str")
+        return {str(k): str(v) for k, v in value.items()}
+
+    def materialize(self, value, ctx, session_dir, client, head_address):
+        ctx.env.update(value or {})
+
+
+class WorkingDirPlugin(RuntimeEnvPlugin):
+    name = "working_dir"
+    priority = 5
+
+    def validate(self, value):
+        if not isinstance(value, str) or not os.path.isdir(value):
+            raise ValueError(f"working_dir {value!r} is not a directory")
+        return value
+
+    def upload(self, value, client, head_address):
+        return {"key": _upload_blob(_zip_dir(value), client, head_address)}
+
+    def materialize(self, value, ctx, session_dir, client, head_address):
+        dest = _fetch_extract(value["key"], session_dir, client,
+                              head_address)
+        ctx.cwd = dest
+        ctx.py_paths.append(dest)
+
+
+class PyModulesPlugin(RuntimeEnvPlugin):
+    """Extra importable modules (reference: py_modules.py:1). Each entry
+    is a local package directory; it is zipped UNDER its basename so the
+    extraction root goes on the import path and `import <basename>`
+    works on every worker."""
+
+    name = "py_modules"
+    priority = 7
+
+    def validate(self, value):
+        if isinstance(value, str):
+            value = [value]
+        if not isinstance(value, (list, tuple)):
+            raise ValueError("py_modules must be a list of directories")
+        for p in value:
+            if not isinstance(p, str) or not os.path.isdir(p):
+                raise ValueError(f"py_modules entry {p!r} is not a directory")
+        return list(value)
+
+    def upload(self, value, client, head_address):
+        out = []
+        for p in value:
+            base = os.path.basename(os.path.normpath(p))
+            blob = _zip_dir(p, prefix=base)
+            out.append({"key": _upload_blob(blob, client, head_address),
+                        "module": base})
+        return out
+
+    def materialize(self, value, ctx, session_dir, client, head_address):
+        for ent in value:
+            dest = _fetch_extract(ent["key"], session_dir, client,
+                                  head_address)
+            ctx.py_paths.append(dest)
+
+
+class _GatedPlugin(RuntimeEnvPlugin):
+    """Reference plugins that require package installs, impossible in
+    this deployment; the field names are reserved so the error is
+    actionable rather than 'unknown key' (reference: pip.py, uv.py,
+    conda.py, container plugin)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def validate(self, value):
+        from ray_tpu.core.exceptions import RuntimeEnvSetupError
+
+        raise RuntimeEnvSetupError(
+            f"runtime_env[{self.name!r}] requires installing packages at "
+            f"materialization time, which this deployment forbids (no "
+            f"network installs). Ship code with working_dir/py_modules "
+            f"instead.")
+
+
+_REGISTRY: dict[str, RuntimeEnvPlugin] = {}
+_env_plugins_loaded = False
+
+
+def register_plugin(plugin: RuntimeEnvPlugin):
+    """Add or replace a plugin IN THIS PROCESS. For a plugin that must
+    materialize on every node, also set RAY_TPU_RUNTIME_ENV_PLUGINS to
+    "module:Class[,module:Class...]" — worker/nodelet processes import
+    and register those lazily (reference: the RAY_RUNTIME_ENV_PLUGINS
+    env-var registration, runtime_env/plugin.py)."""
+    if not plugin.name:
+        raise ValueError("plugin needs a non-empty name")
+    _REGISTRY[plugin.name] = plugin
+
+
+def _load_env_plugins():
+    """Register plugins named in RAY_TPU_RUNTIME_ENV_PLUGINS (once)."""
+    global _env_plugins_loaded
+    if _env_plugins_loaded:
+        return
+    _env_plugins_loaded = True
+    spec = os.environ.get("RAY_TPU_RUNTIME_ENV_PLUGINS", "")
+    for ent in spec.split(","):
+        ent = ent.strip()
+        if not ent or ":" not in ent:
+            continue
+        mod_name, cls_name = ent.rsplit(":", 1)
+        try:
+            import importlib
+
+            cls = getattr(importlib.import_module(mod_name), cls_name)
+            register_plugin(cls())
+        except Exception as e:  # noqa: BLE001
+            raise RuntimeError(
+                f"RAY_TPU_RUNTIME_ENV_PLUGINS entry {ent!r} failed to "
+                f"load: {e!r}") from e
+
+
+def _plugin(name: str) -> RuntimeEnvPlugin:
+    _load_env_plugins()
+    p = _REGISTRY.get(name)
+    if p is None:
+        raise ValueError(
+            f"runtime_env plugin {name!r} is not registered in this "
+            f"process; distribute custom plugins to nodes via "
+            f"RAY_TPU_RUNTIME_ENV_PLUGINS='module:Class'")
+    return p
+
+
+def registered_plugins() -> dict[str, RuntimeEnvPlugin]:
+    _load_env_plugins()
+    return dict(_REGISTRY)
+
+
+for _p in (EnvVarsPlugin(), WorkingDirPlugin(), PyModulesPlugin(),
+           _GatedPlugin("pip"), _GatedPlugin("uv"), _GatedPlugin("conda"),
+           _GatedPlugin("container")):
+    register_plugin(_p)
+
+
+# ---------------------------------------------------------------- API
+# (signatures kept stable: nodelet/cluster_runtime call these)
+
+
 def normalize(runtime_env: dict | None, client, head_address: str
               ) -> dict | None:
-    """Validate + make shippable: working_dir is zipped and uploaded to
-    the head KV once (content-addressed), replaced by its key."""
+    """Driver side: validate every field through its plugin and upload
+    blobs once (content-addressed); returns the shippable dict."""
     if not runtime_env:
         return None
-    unknown = set(runtime_env) - _SUPPORTED
+    _load_env_plugins()
+    unknown = set(runtime_env) - set(_REGISTRY)
     if unknown:
         raise ValueError(
             f"unsupported runtime_env keys {sorted(unknown)}; supported: "
-            f"{sorted(_SUPPORTED)} (pip/conda need installs, unavailable "
-            f"in this deployment)")
+            f"{sorted(_REGISTRY)}")
     out: dict = {}
-    env_vars = runtime_env.get("env_vars")
-    if env_vars:
-        out["env_vars"] = {str(k): str(v) for k, v in env_vars.items()}
-    wd = runtime_env.get("working_dir")
-    if wd:
-        if not os.path.isdir(wd):
-            raise ValueError(f"working_dir {wd!r} is not a directory")
-        blob = _zip_dir(wd)
-        key = hashlib.sha1(blob).hexdigest()
-        client.call(head_address, "kv_put",
-                    {"ns": "rtenv", "key": key, "overwrite": False},
-                    frames=[blob], timeout=60, retries=2)
-        out["working_dir_key"] = key
+    for name, value in runtime_env.items():
+        plugin = _plugin(name)
+        value = plugin.validate(value)
+        if value:
+            out[name] = plugin.upload(value, client, head_address)
     return out or None
 
 
@@ -94,35 +324,19 @@ def env_hash(norm: dict | None) -> str:
 
 def materialize(norm: dict | None, session_dir: str, client,
                 head_address: str) -> tuple[dict, str | None]:
-    """Node-side: returns (extra process env, cwd or None). Extraction is
-    content-addressed and idempotent (reference: the per-node runtime-env
-    agent materializes before WorkerPool starts the worker)."""
+    """Node side: run every plugin in priority order against a fresh
+    context; returns (extra process env, cwd or None) for the worker
+    spawn (reference: the per-node runtime-env agent materializes
+    before WorkerPool starts the worker)."""
     if not norm:
         return {}, None
-    extra = dict(norm.get("env_vars") or {})
-    cwd = None
-    key = norm.get("working_dir_key")
-    if key:
-        dest = os.path.join(session_dir, "runtime_envs", key)
-        done = os.path.join(dest, ".ready")
-        if not os.path.exists(done):
-            value, frames = client.call_frames(
-                head_address, "kv_get", {"ns": "rtenv", "key": key},
-                timeout=60, retries=2)
-            if not value.get("found"):
-                raise RuntimeError(f"runtime_env working_dir {key} not in KV")
-            tmp = dest + ".tmp"
-            os.makedirs(tmp, exist_ok=True)
-            with zipfile.ZipFile(io.BytesIO(frames[0])) as z:
-                z.extractall(tmp)
-            os.makedirs(os.path.dirname(dest), exist_ok=True)
-            try:
-                os.rename(tmp, dest)
-            except OSError:
-                pass  # concurrent materialization won
-            with open(done, "w") as f:
-                f.write("ok")
-        cwd = dest
+    ctx = RuntimeEnvContext()
+    for name in sorted(norm, key=lambda n: _plugin(n).priority):
+        _plugin(name).materialize(norm[name], ctx, session_dir, client,
+                                  head_address)
+    extra = dict(ctx.env)
+    if ctx.py_paths:
         prev = extra.get("PYTHONPATH", os.environ.get("PYTHONPATH", ""))
-        extra["PYTHONPATH"] = dest + (os.pathsep + prev if prev else "")
-    return extra, cwd
+        joined = os.pathsep.join(ctx.py_paths)
+        extra["PYTHONPATH"] = joined + (os.pathsep + prev if prev else "")
+    return extra, ctx.cwd

@@ -45,7 +45,8 @@ class TaskSpec:
     placement_group: bytes | None = None
     bundle_index: int = -1
     label_selector: dict | None = None
-    # normalized runtime env: {"env_vars": {...}, "working_dir_key": sha}
+    # normalized runtime env: plugin-name -> shippable value (blobs are
+    # content-addressed head-KV keys), see core/runtime_env.py
     runtime_env: dict | None = None
     # distributed trace context {trace_id, span_id, parent_id}
     # (reference: opentelemetry span propagation through task submission,

@@ -60,8 +60,12 @@ class WorkerRuntime(ClusterRuntime):
         self._event_buf_lock = threading.Lock()
         threading.Thread(target=self._event_flush_loop, daemon=True,
                          name="task-event-flush").start()
+        # the lease this worker currently serves (set by the nodelet at
+        # grant time, cleared at return/expiry); guards direct pushes
+        self._current_lease: bytes | None = None
         self.server.register("execute_task", self._h_execute_task, oneway=True)
         self.server.register("execute_leased", self._h_execute_leased)
+        self.server.register("set_lease", self._h_set_lease)
         self.server.register("become_actor", self._h_become_actor, oneway=True)
         self.server.register("actor_call", self._h_actor_call)
         self.server.register("dag_start", self._h_dag_start)
@@ -174,9 +178,27 @@ class WorkerRuntime(ClusterRuntime):
     def _h_execute_task(self, msg, frames):
         self._exec_task_spec(TaskSpec(**msg["spec"]), notify_nodelet=True)
 
+    def _h_set_lease(self, msg, frames):
+        """Nodelet-driven lease handoff. A keyed clear only applies if the
+        named lease is still current, so a clear racing a re-grant can
+        never clobber the new lease."""
+        clear = msg.get("clear")
+        if clear is not None:
+            if self._current_lease == clear:
+                self._current_lease = None
+        else:
+            self._current_lease = msg["lease_id"]
+        return {}
+
     def _h_execute_leased(self, msg, frames):
         """Enqueue-ack for a direct leased push. Dedup by (task_id,
         attempt): the owner's submit sweeper may resend after a slow ack."""
+        lid = msg.get("lease_id")
+        if lid is not None and lid != self._current_lease:
+            # stale push: the nodelet already re-credited this lease's
+            # resources (TTL expiry / re-grant); running it would
+            # oversubscribe the node (ADVICE r3). Owner resubmits classic.
+            raise exc.StaleLeaseError("lease no longer held by this worker")
         key = msg["spec"]["task_id"] + bytes([msg.get("attempt", 0) & 0xFF])
         with self._seen_lock:
             if key in self._seen_calls:

@@ -4,12 +4,25 @@ Reference parity: the dashboard head's REST surface
 (python/ray/dashboard/head.py + modules/{node,actor,job}) scoped to the
 state endpoints and a minimal auto-refreshing HTML page — no React
 frontend. Serves: / (HTML), /api/state, /api/nodes, /api/actors,
-/api/pgs, /api/jobs, /metrics (this process's Prometheus registry)."""
+/api/pgs, /api/jobs, /api/objects, /api/memory (owner-side object
+tables + per-node store usage, the `ray memory` role), /api/history
+(ring buffer of cluster summaries, 1h at 5s), /metrics (this
+process's Prometheus registry)."""
 
 from __future__ import annotations
 
 import json
 import threading
+import time
+from collections import deque
+
+# node-metrics history ring (reference role: the dashboard's metrics
+# module keeps time series; here a bounded in-memory ring served at
+# /api/history — 720 samples x 5s = 1h)
+_HISTORY_MAXLEN = 720
+_HISTORY_INTERVAL_S = 5.0
+_history: deque = deque(maxlen=_HISTORY_MAXLEN)
+_sampler_stop = None
 
 _PAGE = """<!doctype html>
 <html><head><title>ray_tpu dashboard</title>
@@ -57,9 +70,20 @@ load();
 _server = None
 
 
+def _sample_loop(head_address, stop: threading.Event):
+    from ray_tpu.util import state
+
+    while not stop.wait(_HISTORY_INTERVAL_S):
+        try:
+            s = state.summarize(head_address)
+            _history.append({"time": time.time(), **s})
+        except Exception:  # noqa: BLE001
+            pass  # head briefly unreachable; the gap itself is the signal
+
+
 def start_dashboard(head_address: str | None = None, port: int = 8265) -> int:
     """Start the dashboard HTTP server; returns the bound port."""
-    global _server
+    global _server, _sampler_stop
     import http.server
 
     from ray_tpu.util import metrics as metrics_mod
@@ -96,6 +120,17 @@ def start_dashboard(head_address: str | None = None, port: int = 8265) -> int:
                 elif self.path == "/api/jobs":
                     self._send(json.dumps(_jobs(head_address)).encode(),
                                "application/json")
+                elif self.path == "/api/objects":
+                    self._send(json.dumps(
+                        state.list_objects(head_address)).encode(),
+                        "application/json")
+                elif self.path == "/api/memory":
+                    self._send(json.dumps(
+                        state.memory_summary(head_address)).encode(),
+                        "application/json")
+                elif self.path == "/api/history":
+                    self._send(json.dumps(list(_history)).encode(),
+                               "application/json")
                 elif self.path == "/metrics":
                     self._send(metrics_mod.prometheus_text().encode(),
                                "text/plain; version=0.0.4")
@@ -129,6 +164,9 @@ def start_dashboard(head_address: str | None = None, port: int = 8265) -> int:
     _server = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
     threading.Thread(target=_server.serve_forever, daemon=True,
                      name="dashboard-http").start()
+    _sampler_stop = threading.Event()
+    threading.Thread(target=_sample_loop, args=(head_address, _sampler_stop),
+                     daemon=True, name="dashboard-sampler").start()
     return _server.server_address[1]
 
 
@@ -147,7 +185,11 @@ def _jobs(head_address: str | None) -> list[dict]:
 
 
 def stop_dashboard():
-    global _server
+    global _server, _sampler_stop
+    if _sampler_stop is not None:
+        _sampler_stop.set()
+        _sampler_stop = None
     if _server is not None:
         _server.shutdown()
         _server = None
+    _history.clear()

@@ -22,12 +22,14 @@ from ray_tpu.data.dataset import (
     from_numpy,
     range,
     read_csv,
+    read_datasource,
     read_json,
     read_parquet,
     read_text,
 )
+from ray_tpu.data.datasource import Datasource, ReadTask
 
-__all__ = ["AggregateFn", "Count", "Dataset", "GroupedData", "Max",
-           "Mean", "Min", "Std", "Sum", "from_arrow", "from_items",
-           "from_numpy", "range", "read_csv", "read_json",
-           "read_parquet", "read_text"]
+__all__ = ["AggregateFn", "Count", "Dataset", "Datasource", "GroupedData",
+           "Max", "Mean", "Min", "ReadTask", "Std", "Sum", "from_arrow",
+           "from_items", "from_numpy", "range", "read_csv",
+           "read_datasource", "read_json", "read_parquet", "read_text"]

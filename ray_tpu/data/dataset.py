@@ -22,28 +22,29 @@ from ray_tpu.data.block import (
     split_blocks,
 )
 
+from ray_tpu.data.plan import (
+    FilterRows,
+    FlatMapRows,
+    Limit,
+    LogicalOperator,
+    LogicalPlan,
+    MapBatches as _MapBatchesOp,
+    MapRows,
+    Read as _ReadOp,
+)
+
 _DEFAULT_PARALLELISM = 8
 
 
-class _Op:
-    """One logical operator: fn maps a block (list of rows) -> block."""
-
-    def __init__(self, kind: str, fn: Callable[[list], list]):
-        self.kind = kind
-        self.fn = fn
-
-
-def _fuse(ops: list[_Op]) -> Callable[[list], list]:
-    def fused(block: list) -> list:
-        for op in ops:
-            block = op.fn(block)
-        return block
-
-    return fused
+def _fuse(ops: list[LogicalOperator]) -> Callable[[list], list]:
+    """Optimized physical form of the operator chain (rule-based: limit
+    pushdown, limit collapse, map fusion — see data/plan.py)."""
+    return LogicalPlan(list(ops)).compile()
 
 
 class Dataset:
-    def __init__(self, block_refs: list, ops: list[_Op] | None = None):
+    def __init__(self, block_refs: list,
+                 ops: list[LogicalOperator] | None = None):
         self._block_refs = block_refs  # ObjectRefs of input blocks
         self._ops = ops or []
 
@@ -52,6 +53,9 @@ class Dataset:
     @staticmethod
     def from_items(items: Iterable, parallelism: int = _DEFAULT_PARALLELISM
                    ) -> "Dataset":
+        """Eager in-memory blocks (items are already resident in the
+        driver). For deferred materialization of generated data use
+        read_datasource(ItemsDatasource(...)) — same seam as range()."""
         import ray_tpu
 
         blocks = split_blocks(items, parallelism)
@@ -59,22 +63,53 @@ class Dataset:
 
     @staticmethod
     def range(n: int, parallelism: int = _DEFAULT_PARALLELISM) -> "Dataset":
-        return Dataset.from_items(builtins.range(n), parallelism)
+        """Lazy integer range THROUGH the datasource seam: blocks
+        materialize inside read tasks, never on the driver (reference:
+        ray.data.range is a Datasource read)."""
+        from ray_tpu.data.datasource import RangeDatasource
+
+        return read_datasource(RangeDatasource(n), parallelism=parallelism)
 
     # ------------------------------------------------------------ transforms
 
-    def _with(self, op: _Op) -> "Dataset":
+    def _with(self, op: LogicalOperator) -> "Dataset":
         return Dataset(self._block_refs, self._ops + [op])
 
     def map(self, fn: Callable) -> "Dataset":
-        return self._with(_Op("map", lambda b: [fn(r) for r in b]))
+        return self._with(MapRows(fn))
 
     def filter(self, fn: Callable) -> "Dataset":
-        return self._with(_Op("filter", lambda b: [r for r in b if fn(r)]))
+        return self._with(FilterRows(fn))
 
     def flat_map(self, fn: Callable) -> "Dataset":
-        return self._with(
-            _Op("flat_map", lambda b: [o for r in b for o in fn(r)]))
+        return self._with(FlatMapRows(fn))
+
+    def limit(self, n: int) -> "Dataset":
+        """GLOBAL row cap (reference: Dataset.limit). As a plan suffix
+        (possibly under 1:1 maps, which the optimizer pushes it past)
+        the consuming iterator stops the stream at n rows; when a
+        non-1:1 operator FOLLOWS the limit, execution materializes the
+        capped rows first (`_split_at_mid_limit`) so downstream sees
+        exactly n rows, not n per block."""
+        return self._with(Limit(n))
+
+    def _split_at_mid_limit(self) -> "Dataset | None":
+        """If the plan has a Limit followed by any non-1:1 operator,
+        return an equivalent dataset with everything up to (and incl.)
+        that limit MATERIALIZED — per-block limiting alone would leak
+        n rows per block into the downstream operator."""
+        last = None
+        for i, op in enumerate(self._ops):
+            if isinstance(op, Limit) and any(
+                    not o.one_to_one and not isinstance(o, Limit)
+                    for o in self._ops[i + 1:]):
+                last = i
+        if last is None:
+            return None
+        prefix = Dataset(self._block_refs, self._ops[:last + 1])
+        rows = prefix.take_all()  # iterator cap enforces the global n
+        out = Dataset.from_items(rows, max(1, len(self._block_refs)))
+        return Dataset(out._block_refs, self._ops[last + 1:])
 
     def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
                     compute: str | None = None, num_actors: int = 2
@@ -99,7 +134,7 @@ class Dataset:
             ds = Dataset(self._block_refs, self._ops)
             ds._actor_stage = (apply, num_actors)  # type: ignore[attr-defined]
             return ds
-        return self._with(_Op("map_batches", apply))
+        return self._with(_MapBatchesOp(apply))
 
     def repartition(self, num_blocks: int) -> "Dataset":
         rows = self.take_all()
@@ -110,13 +145,25 @@ class Dataset:
     def _out_partitions(self, num_blocks: int | None) -> int:
         return max(1, num_blocks or len(self._block_refs))
 
+    def _exchange_input(self) -> tuple[list, list]:
+        """(block_refs, ops) to feed an all-to-all exchange. A plan
+        containing a Limit must be materialized first — the exchange's
+        map stage is per-block, so a per-block limit would leak n rows
+        PER BLOCK into the shuffle instead of n total."""
+        if any(isinstance(o, Limit) for o in self._ops):
+            rows = self.take_all()
+            ds = Dataset.from_items(rows, max(1, len(self._block_refs)))
+            return ds._block_refs, []
+        return self._block_refs, self._ops
+
     def random_shuffle(self, *, seed: int | None = None,
                        num_blocks: int | None = None) -> "Dataset":
         """Global row shuffle via a map/partition/reduce exchange
         (reference: Dataset.random_shuffle, data/dataset.py:1374)."""
         from ray_tpu.data.exchange import shuffle_exchange
 
-        refs = shuffle_exchange(self._block_refs, _fuse(self._ops),
+        refs, ops = self._exchange_input()
+        refs = shuffle_exchange(refs, _fuse(ops),
                                 self._out_partitions(num_blocks), seed)
         return Dataset(refs)
 
@@ -127,7 +174,8 @@ class Dataset:
         None for the row itself."""
         from ray_tpu.data.exchange import sort_exchange
 
-        refs = sort_exchange(self._block_refs, _fuse(self._ops),
+        refs, ops = self._exchange_input()
+        refs = sort_exchange(refs, _fuse(ops),
                              self._out_partitions(num_blocks), key,
                              descending)
         ds = Dataset(refs)
@@ -142,7 +190,8 @@ class Dataset:
     def unique(self, key=None) -> list:
         from ray_tpu.data.exchange import groupby_exchange
 
-        refs = groupby_exchange(self._block_refs, _fuse(self._ops),
+        refs, ops = self._exchange_input()
+        refs = groupby_exchange(refs, _fuse(ops),
                                 self._out_partitions(None), key,
                                 lambda k, rows: k)
         return [v for r in Dataset(refs).iter_rows() for v in [r]]
@@ -171,6 +220,11 @@ class Dataset:
         if not self._ops and actor_stage is None:
             yield from self._block_refs
             return
+        if actor_stage is None:
+            split = self._split_at_mid_limit()
+            if split is not None:
+                yield from split._execute(max_in_flight, memory_budget)
+                return
         fused = _fuse(self._ops)
         from ray_tpu.data.executor import StreamingExecutor, default_policies
 
@@ -218,6 +272,11 @@ class Dataset:
     def materialize(self) -> "Dataset":
         import ray_tpu
 
+        if LogicalPlan(self._ops).global_limit() is not None:
+            # a suffix limit is a GLOBAL cap enforced by the row
+            # iterator; raw _execute blocks would carry n rows per block
+            return Dataset.from_items(self.take_all(),
+                                      max(1, len(self._block_refs)))
         refs = list(self._execute())
         # re-put to pin materialized blocks under driver ownership
         blocks = ray_tpu.get(refs, timeout=600)
@@ -228,8 +287,20 @@ class Dataset:
     def iter_rows(self) -> Iterator:
         import ray_tpu
 
+        # a plan-suffix Limit caps the GLOBAL row count: stop the stream
+        # (and its in-flight work) as soon as it is met
+        cap = LogicalPlan(self._ops).global_limit()
+        n = 0
         for ref in self._execute():
-            yield from ray_tpu.get(ref, timeout=600)
+            for row in ray_tpu.get(ref, timeout=600):
+                yield row
+                n += 1
+                if cap is not None and n >= cap:
+                    return
+
+    def explain(self) -> str:
+        """The optimized logical plan (reference: Dataset plan repr)."""
+        return LogicalPlan(self._ops).optimized().describe()
 
     def iter_batches(self, *, batch_size: int = 256,
                      batch_format: str = "numpy") -> Iterator:
@@ -254,6 +325,58 @@ class Dataset:
                 buf = []
         if buf:
             yield fmt(buf)
+
+    def iter_jax_batches(self, *, batch_size: int = 256,
+                         sharding=None, mesh=None,
+                         drop_last: bool = True) -> Iterator:
+        """Device-feed iterator (reference role: iter_torch_batches,
+        data/_internal/iterator/iter_batches.py — host block →
+        device-resident training batch). Each fixed-size numpy batch is
+        `jax.device_put` onto the mesh with a NamedSharding whose batch
+        dim spans the replica axes, so the ingest pipeline hands the
+        train step GLOBAL arrays ready for a pjit'd step.
+
+        Pass either `sharding` (any jax Sharding, applied to every leaf)
+        or `mesh` (batch dim sharded over the mesh's replica-ish axes,
+        same rule as train.spmd.batch_shardings). With neither, batches
+        land on the default device. Overlap comes from XLA's async
+        dispatch: device_put returns immediately, so the next host
+        batch's prep runs while the previous transfer is in flight.
+        `drop_last=True` keeps every yielded batch shape-identical —
+        required under jit (no recompiles) and for even sharding."""
+        import jax
+
+        if sharding is None and mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from ray_tpu.parallel.mesh import BATCH_AXES
+
+            axes = tuple(a for a in BATCH_AXES
+                         if dict(mesh.shape).get(a, 1) > 1)
+            sharding = NamedSharding(mesh,
+                                     PartitionSpec(axes if axes else None))
+        if sharding is not None and not drop_last:
+            # a partial last batch's row count need not divide the shard
+            # count — device_put would explode mid-iteration; fail early
+            raise ValueError(
+                "iter_jax_batches: drop_last=False cannot be combined "
+                "with a sharding/mesh (the final partial batch may not "
+                "divide evenly across shards)")
+
+        def put(batch):
+            if sharding is None:
+                return jax.tree.map(jax.device_put, batch)
+            return jax.tree.map(lambda a: jax.device_put(a, sharding),
+                                batch)
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy"):
+            leaves = jax.tree.leaves(batch)
+            if not leaves:
+                continue
+            if drop_last and len(leaves[0]) < batch_size:
+                continue
+            yield put(batch)
 
     def take(self, n: int = 20) -> list:
         out = []
@@ -290,6 +413,10 @@ class Dataset:
 
         import ray_tpu
 
+        if LogicalPlan(self._ops).global_limit() is not None:
+            # enforce the GLOBAL cap before writing (per-block slices
+            # would write n rows per block)
+            return self.materialize().write_parquet(directory)
         _os.makedirs(directory, exist_ok=True)
         paths = []
         for i, ref in enumerate(self._execute()):
@@ -309,6 +436,8 @@ class Dataset:
 
         import ray_tpu
 
+        if LogicalPlan(self._ops).global_limit() is not None:
+            return self.materialize().write_jsonl(directory)
         _os.makedirs(directory, exist_ok=True)
         paths = []
         for i, ref in enumerate(self._execute()):
@@ -321,7 +450,7 @@ class Dataset:
         return paths
 
     def __repr__(self):
-        ops = "->".join(o.kind for o in self._ops) or "source"
+        ops = "->".join(o.name for o in self._ops) or "source"
         return f"Dataset(blocks={len(self._block_refs)}, plan={ops})"
 
 
@@ -384,8 +513,9 @@ class GroupedData:
     def _exchange(self, group_reducer) -> Dataset:
         from ray_tpu.data.exchange import groupby_exchange
 
+        refs, ops = self._ds._exchange_input()
         refs = groupby_exchange(
-            self._ds._block_refs, _fuse(self._ds._ops),
+            refs, _fuse(ops),
             self._ds._out_partitions(None), self._key, group_reducer)
         return Dataset(refs)
 
@@ -440,81 +570,43 @@ def from_numpy(arr: np.ndarray, parallelism: int = _DEFAULT_PARALLELISM
     return Dataset.from_items(list(arr), parallelism)
 
 
-def _paths_of(paths) -> list[str]:
-    import glob as _glob
-    import os as _os
-
-    out = []
-    for p in [paths] if isinstance(paths, str) else list(paths):
-        if _os.path.isdir(p):
-            out.extend(sorted(
-                _os.path.join(p, f) for f in _os.listdir(p)
-                if _os.path.isfile(_os.path.join(p, f))))
-        elif any(ch in p for ch in "*?["):
-            out.extend(sorted(_glob.glob(p)))
-        else:
-            out.append(p)
-    if not out:
-        raise FileNotFoundError(f"no files match {paths!r}")
-    return out
-
-
-def _read_source(paths, read_block) -> Dataset:
-    """One block per file, read INSIDE tasks (lazy/streaming — the
-    datasource pattern, data/datasource/)."""
+def read_datasource(datasource, *,
+                    parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
+    """Lazy Dataset over any Datasource (reference:
+    ray.data.read_datasource; data/datasource/datasource.py contract).
+    Each ReadTask materializes its block INSIDE a remote task — the
+    driver only ships the thunks."""
     import ray_tpu
 
-    refs = [ray_tpu.put([p]) for p in _paths_of(paths)]
-    return Dataset(refs, [_Op("read", read_block)])
+    tasks = datasource.get_read_tasks(parallelism)
+    if not tasks:
+        raise ValueError(f"{datasource.name} produced no read tasks")
+    refs = [ray_tpu.put([t]) for t in tasks]
+    return Dataset(refs, [_ReadOp(lambda block: block[0]())])
 
 
 def read_text(paths) -> Dataset:
     """One row per line (reference: ray.data.read_text). The line
     splitting runs in the native mmap scanner (data/lineio.py ->
     _native/lineio.cc) inside the read task."""
+    from ray_tpu.data.datasource import TextDatasource
 
-    def rd(block):
-        from ray_tpu.data.lineio import read_lines
-
-        out = []
-        for path in block:
-            out.extend(read_lines(path))
-        return out
-
-    return _read_source(paths, rd)
+    return read_datasource(TextDatasource(paths))
 
 
 def read_csv(paths) -> Dataset:
     """Dict rows from CSV with a header (reference: ray.data.read_csv;
     stdlib csv instead of Arrow)."""
+    from ray_tpu.data.datasource import CSVDatasource
 
-    def rd(block):
-        import csv
-
-        out = []
-        for path in block:
-            with open(path, newline="") as f:
-                out.extend(dict(r) for r in csv.DictReader(f))
-        return out
-
-    return _read_source(paths, rd)
+    return read_datasource(CSVDatasource(paths))
 
 
 def read_json(paths) -> Dataset:
     """JSONL rows (reference: ray.data.read_json)."""
+    from ray_tpu.data.datasource import JSONLDatasource
 
-    def rd(block):
-        import json
-
-        from ray_tpu.data.lineio import read_lines
-
-        out = []
-        for path in block:
-            out.extend(json.loads(line) for line in read_lines(path)
-                       if line.strip())
-        return out
-
-    return _read_source(paths, rd)
+    return read_datasource(JSONLDatasource(paths))
 
 
 def read_parquet(paths, columns: list[str] | None = None) -> Dataset:
@@ -522,16 +614,9 @@ def read_parquet(paths, columns: list[str] | None = None) -> Dataset:
     tasks (reference: ray.data.read_parquet backed by
     data/_internal/arrow_block.py). Rows surface as dicts; use
     map_batches(batch_format="pyarrow") to stay columnar."""
+    from ray_tpu.data.datasource import ParquetDatasource
 
-    def rd(block):
-        import pyarrow.parquet as pq
-
-        out = []
-        for path in block:
-            out.extend(pq.read_table(path, columns=columns).to_pylist())
-        return out
-
-    return _read_source(paths, rd)
+    return read_datasource(ParquetDatasource(paths, columns))
 
 
 def from_arrow(table, parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:

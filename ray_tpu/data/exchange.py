@@ -35,26 +35,32 @@ def _stable_hash(key) -> int:
 
 def exchange(block_refs: list, fused: Callable[[list], list],
              num_partitions: int,
-             partitioner: Callable[[list], list[list]],
-             reducer: Callable[[list[list]], list]) -> list:
-    """Run the two-stage exchange; returns refs of P reduced blocks."""
+             partitioner: Callable[[list, int], list[list]],
+             reducer: Callable[[list[list], int], list]) -> list:
+    """Run the two-stage exchange; returns refs of P reduced blocks.
+
+    The partitioner receives (rows, block_index) and the reducer
+    (parts, partition_index) so randomized exchanges can derive
+    DISTINCT per-task rng streams from one user seed (the reference
+    derives per-task seeds the same way; a single shared stream makes
+    a seeded shuffle collapse to a tiny subset of permutations)."""
     import ray_tpu
 
     P = max(1, num_partitions)
 
     @ray_tpu.remote(num_cpus=1, num_returns=P)
-    def _map(block):
-        parts = partitioner(fused(block))
+    def _map(idx, block):
+        parts = partitioner(fused(block), idx)
         return tuple(parts) if P > 1 else parts[0]
 
     @ray_tpu.remote(num_cpus=1)
-    def _reduce(*parts):
-        return reducer(list(parts))
+    def _reduce(p, *parts):
+        return reducer(list(parts), p)
 
-    map_outs = [_map.remote(ref) for ref in block_refs]
+    map_outs = [_map.remote(i, ref) for i, ref in enumerate(block_refs)]
     if P == 1:
         map_outs = [[r] for r in map_outs]
-    return [_reduce.remote(*[m[p] for m in map_outs]) for p in range(P)]
+    return [_reduce.remote(p, *[m[p] for m in map_outs]) for p in range(P)]
 
 
 # ---------------------------------------------------------------- shuffle
@@ -62,8 +68,12 @@ def exchange(block_refs: list, fused: Callable[[list], list],
 def shuffle_exchange(block_refs, fused, num_partitions, seed=None):
     import numpy as _np
 
-    def partitioner(rows):
-        rng = _np.random.default_rng(seed)
+    # namespaced per-task streams: mappers draw from [seed, 0, idx] and
+    # reducers from [seed, 1, p] so the two families can never collide
+    # (with [seed, idx] vs [seed, P+p], block idx == P+p reused a stream)
+    def partitioner(rows, idx):
+        rng = _np.random.default_rng(
+            None if seed is None else [seed, 0, idx])
         buckets: list[list] = [[] for _ in range(num_partitions)]
         if rows:
             for row, b in zip(rows, rng.integers(0, num_partitions,
@@ -71,9 +81,10 @@ def shuffle_exchange(block_refs, fused, num_partitions, seed=None):
                 buckets[int(b)].append(row)
         return buckets
 
-    def reducer(parts):
+    def reducer(parts, p):
         rows = [r for part in parts for r in part]
-        rng = _np.random.default_rng(None if seed is None else seed + 1)
+        rng = _np.random.default_rng(
+            None if seed is None else [seed, 1, p])
         rng.shuffle(rows)
         return rows
 
@@ -113,13 +124,13 @@ def sort_exchange(block_refs, fused, num_partitions, key=None,
     boundaries = [samples[int(len(samples) * (i + 1) / P)]
                   for i in range(P - 1)] if samples else []
 
-    def partitioner(rows):
+    def partitioner(rows, _idx):
         buckets: list[list] = [[] for _ in range(P)]
         for r in rows:
             buckets[bisect.bisect_right(boundaries, kf(r))].append(r)
         return buckets
 
-    def reducer(parts):
+    def reducer(parts, _p):
         rows = [r for part in parts for r in part]
         rows.sort(key=kf, reverse=descending)
         return rows
@@ -136,13 +147,13 @@ def groupby_exchange(block_refs, fused, num_partitions, key,
     each group. Output rows ordered by key within each block."""
     kf = _key_fn(key)
 
-    def partitioner(rows):
+    def partitioner(rows, _idx):
         buckets: list[list] = [[] for _ in range(num_partitions)]
         for r in rows:
             buckets[_stable_hash(kf(r)) % num_partitions].append(r)
         return buckets
 
-    def reducer(parts):
+    def reducer(parts, _p):
         groups: dict = {}
         for part in parts:
             for r in part:

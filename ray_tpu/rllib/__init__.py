@@ -9,12 +9,13 @@ learner.py:109, torch DDP wrap replaced by GSPMD), prioritized replay
 (execution/segment_tree.py), hierarchical metrics
 (utils/metrics/metrics_logger.py), offline RL (offline_data.py:22 —
 recording, BC, MARWIL), multi-agent (multi_rl_module.py:49 +
-MultiAgentEnv), and seven algorithm families: PPO, APPO, IMPALA,
-DQN (+PER), SAC, BC, MARWIL.
+MultiAgentEnv), and nine algorithm families: PPO, APPO, IMPALA,
+DQN (+PER), SAC, CQL, DreamerV3, BC, MARWIL.
 """
 
 from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.catalog import Catalog
+from ray_tpu.rllib.cql import CQL, CQLConfig, record_continuous_experiences
 from ray_tpu.rllib.connectors import (
     ConnectorPipeline,
     ConnectorV2,
@@ -24,6 +25,7 @@ from ray_tpu.rllib.connectors import (
     NormalizeImage,
 )
 from ray_tpu.rllib.dqn import DQN, DQNConfig, ReplayBuffer
+from ray_tpu.rllib.dreamerv3 import DreamerV3, DreamerV3Config
 from ray_tpu.rllib.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace
 from ray_tpu.rllib.learner import PPOLearner, PPOLearnerConfig, compute_gae
@@ -51,11 +53,15 @@ __all__ = [
     "BC",
     "BCConfig",
     "MARWILConfig",
+    "CQL",
+    "CQLConfig",
     "Catalog",
     "ConnectorPipeline",
     "ConnectorV2",
     "DQN",
     "DQNConfig",
+    "DreamerV3",
+    "DreamerV3Config",
     "EnvRunnerGroup",
     "FlattenObs",
     "FrameStack",
@@ -80,6 +86,7 @@ __all__ = [
     "SumTree",
     "compute_gae",
     "load_offline_dataset",
+    "record_continuous_experiences",
     "record_experiences",
     "vtrace",
 ]

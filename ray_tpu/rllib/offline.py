@@ -72,13 +72,19 @@ def record_experiences(env: str, num_episodes: int, out_dir: str,
 
 def load_offline_dataset(path: str):
     """OfflineData role (offline_data.py:22): a Dataset of experience
-    rows for offline training."""
+    rows for offline training. Format is sniffed from the files on disk
+    (reads are LAZY, so a wrong-format guess would only explode later
+    inside a map task)."""
+    import glob as _glob
+    import os as _os
+
     from ray_tpu import data as rd
 
-    try:
+    names = (_glob.glob(_os.path.join(path, "*"))
+             if _os.path.isdir(path) else [path])
+    if any(n.endswith((".parquet", ".pq")) for n in names):
         return rd.read_parquet(path)
-    except Exception:  # noqa: BLE001
-        return rd.read_json(path)
+    return rd.read_json(path)
 
 
 @dataclasses.dataclass

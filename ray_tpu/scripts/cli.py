@@ -114,10 +114,21 @@ def cmd_list(args):
         rows = state.list_nodes(address=args.address)
     elif args.kind == "pgs":
         rows = state.list_placement_groups(address=args.address)
+    elif args.kind == "objects":
+        rows = state.list_objects(address=args.address)
     else:
         print(f"unknown kind {args.kind}", file=sys.stderr)
         return 2
     print(json.dumps(rows, indent=2, default=str))
+    return 0
+
+
+def cmd_memory(args):
+    """Per-node store usage + per-owner object footprint (reference:
+    `ray memory`)."""
+    from ray_tpu.util import state
+
+    print(state.memory_report(address=args.address))
     return 0
 
 
@@ -185,9 +196,14 @@ def main(argv=None):
     p.set_defaults(fn=cmd_status)
 
     p = sub.add_parser("list")
-    p.add_argument("kind", choices=["actors", "nodes", "pgs", "tasks"])
+    p.add_argument("kind",
+                   choices=["actors", "nodes", "objects", "pgs", "tasks"])
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("memory")
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_memory)
 
     p = sub.add_parser("logs")
     p.add_argument("node", help="node id (hex prefix)")

@@ -85,6 +85,91 @@ def list_placement_groups(address: str | None = None) -> list[dict]:
     return _head_call("pg_table", address=address).get("groups", [])
 
 
+def _node_object_tables(address: str | None) -> tuple[list[dict],
+                                                      list[dict]]:
+    """One fan-out pass: (per-node rows incl. store stats, all owned
+    objects — workers' via their nodelet + the calling driver's own)."""
+    from ray_tpu.core import api as _api
+    from ray_tpu.core.rpc import RpcClient
+
+    objects: list[dict] = []
+    rt = _api._runtime
+    if rt is not None and hasattr(rt, "_h_list_objects"):
+        objects.extend(rt._h_list_objects({}, [])["objects"])
+    nodes = []
+    for n in list_nodes(address):
+        if not n["alive"]:
+            continue
+        try:
+            r = RpcClient.shared().call(n["address"], "list_node_objects",
+                                        {}, timeout=20)
+        except Exception:  # noqa: BLE001
+            continue
+        objects.extend(r.get("objects", ()))
+        store = r.get("store", {})
+        nodes.append({
+            "node_id": n["node_id"],
+            "address": n["address"],
+            "store_bytes_allocated": store.get("bytes_allocated", 0),
+            "store_capacity": store.get("capacity", 0),
+            "store_num_objects": store.get("num_objects", 0),
+            "store_evictions": store.get("evictions", 0),
+            "oom_kills": r.get("oom_kills", 0),
+        })
+    return nodes, objects
+
+
+def list_objects(address: str | None = None) -> list[dict]:
+    """Cluster-wide owner-side object tables (reference:
+    `ray list objects`, python/ray/util/state/api.py:1). Covers every
+    worker's owned objects via its nodelet, plus the calling driver's
+    own table."""
+    return _node_object_tables(address)[1]
+
+
+def memory_summary(address: str | None = None) -> dict:
+    """Per-node store usage + per-owner object footprint (reference:
+    the `ray memory` report)."""
+    nodes, objects = _node_object_tables(address)
+    by_owner: dict[str, dict] = {}
+    for o in objects:
+        agg = by_owner.setdefault(o["owner"], {"count": 0, "bytes": 0,
+                                               "spilled": 0, "borrowed": 0})
+        agg["count"] += 1
+        agg["bytes"] += o.get("size", 0) or 0
+        agg["spilled"] += 1 if o.get("spilled") else 0
+        agg["borrowed"] += o.get("borrowers", 0)
+    return {
+        "nodes": nodes,
+        "objects_total": len(objects),
+        "objects_bytes": sum((o.get("size") or 0) for o in objects),
+        "by_owner": by_owner,
+    }
+
+
+def memory_report(address: str | None = None) -> str:
+    """Human-readable `ray_tpu memory` view."""
+    s = memory_summary(address)
+    lines = ["=== object store per node ==="]
+    for n in s["nodes"]:
+        cap = n["store_capacity"] or 1
+        lines.append(
+            f"  {n['node_id'][:12]} {n['address']:<21} "
+            f"{n['store_bytes_allocated'] / (1 << 20):8.1f}MB / "
+            f"{cap / (1 << 20):7.1f}MB  objs={n['store_num_objects']:<6} "
+            f"evictions={n['store_evictions']:<6} "
+            f"oom_kills={n['oom_kills']}")
+    lines.append(f"=== owned objects: {s['objects_total']} "
+                 f"({s['objects_bytes'] / (1 << 20):.1f}MB) ===")
+    for owner, agg in sorted(s["by_owner"].items(),
+                             key=lambda kv: -kv[1]["bytes"]):
+        lines.append(
+            f"  {owner:<21} count={agg['count']:<6} "
+            f"bytes={agg['bytes'] / (1 << 20):8.1f}MB "
+            f"spilled={agg['spilled']:<4} borrowed={agg['borrowed']}")
+    return "\n".join(lines)
+
+
 def summarize(address: str | None = None) -> dict:
     nodes = list_nodes(address)
     actors = list_actors(address)

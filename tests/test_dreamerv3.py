@@ -1,0 +1,128 @@
+"""DreamerV3 (reference model: rllib/algorithms/dreamerv3/tests) —
+world-model learning signal, imagination machinery, replay windows.
+
+CPU-scale smoke: full learning-to-solve is out of budget here; what is
+pinned down is (a) the world model FITS (its loss drops substantially
+over replayed updates), (b) symlog/twohot invariants, (c) sequence
+replay contiguity + episode-boundary flags, (d) checkpoint roundtrip.
+"""
+
+import sys
+
+import cloudpickle
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.dreamerv3 import (
+    BINS,
+    DreamerV3Config,
+    EpisodeSequenceBuffer,
+    symexp,
+    symlog,
+    twohot,
+    twohot_mean,
+)
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+# ---------------------------------------------------------------------------
+# units
+# ---------------------------------------------------------------------------
+
+def test_symlog_symexp_roundtrip():
+    x = np.array([-100.0, -1.0, 0.0, 0.5, 3.0, 1000.0])
+    np.testing.assert_allclose(np.asarray(symexp(symlog(x))), x, rtol=1e-5)
+
+
+def test_twohot_is_distribution_and_invertible():
+    y = np.array([-7.3, 0.0, 0.4, 12.0])
+    th = np.asarray(twohot(y))
+    assert th.shape == (4, len(BINS))
+    np.testing.assert_allclose(th.sum(-1), 1.0, rtol=1e-5)
+    # expected value through log-space decode recovers the input
+    logits = np.log(th + 1e-9)
+    np.testing.assert_allclose(np.asarray(twohot_mean(logits)), y,
+                               rtol=0.05, atol=0.05)
+
+
+def test_sequence_buffer_windows_contiguous():
+    buf = EpisodeSequenceBuffer(1000, num_envs=2, seed=0)
+    for t in range(30):
+        buf.add_step({"obs": np.array([[t, 0], [t, 1]], np.float32),
+                      "first": np.array([t % 10 == 0, False], np.float32)})
+    assert buf.can_sample(4, 8)
+    s = buf.sample_sequences(4, 8)
+    assert s["obs"].shape == (4, 8, 2)
+    for b in range(4):
+        ts = s["obs"][b, :, 0]
+        assert np.all(np.diff(ts) == 1), f"window not contiguous: {ts}"
+        env = s["obs"][b, :, 1]
+        assert len(set(env.tolist())) == 1, "window crossed env streams"
+
+
+def test_sequence_buffer_capacity_evicts_oldest():
+    buf = EpisodeSequenceBuffer(20, num_envs=2, seed=0)  # 10 per stream
+    for t in range(25):
+        buf.add_step({"obs": np.array([[t], [t]], np.float32)})
+    s = buf.sample_sequences(8, 10)
+    assert s["obs"].min() >= 15  # only the newest 10 survive
+
+
+# ---------------------------------------------------------------------------
+# algorithm
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def algo():
+    a = (DreamerV3Config()
+         .environment("CartPole-v1")
+         .training(model_size="XS", training_ratio=8.0, batch_size_B=4,
+                   batch_length_T=8, horizon_H=5, num_envs=4,
+                   rollout_fragment_length=16, seed=0)).build()
+    yield a
+    a.stop()
+
+
+def test_world_model_fits(algo):
+    """The decisive smoke: wm total loss drops substantially as the
+    world model sees replayed experience."""
+    first = None
+    last = None
+    for _ in range(6):
+        r = algo.train()
+        if "wm/total" in r:
+            if first is None:
+                first = r["wm/total"]
+            last = r["wm/total"]
+    assert first is not None and last is not None, "no updates ran"
+    assert np.isfinite(last)
+    assert last < first * 0.8, (first, last)
+
+
+def test_metrics_and_imagination_finite(algo):
+    r = algo.train()
+    for k in ("wm/decoder", "wm/reward", "wm/dyn", "wm/rep",
+              "actor/entropy", "critic/value", "imagined_return"):
+        assert k in r, f"missing {k}"
+        assert np.isfinite(r[k]), (k, r[k])
+    assert r["num_env_steps_sampled_lifetime"] > 0
+    assert r["num_steps_replayed"] > 0
+
+
+def test_checkpoint_roundtrip(algo, tmp_path):
+    import jax
+
+    algo.train()
+    path = algo.save_to_path(str(tmp_path / "dv3"))
+    algo2 = (DreamerV3Config()
+             .environment("CartPole-v1")
+             .training(model_size="XS", training_ratio=8.0,
+                       batch_size_B=4, batch_length_T=8, horizon_H=5,
+                       num_envs=4, rollout_fragment_length=16,
+                       seed=99)).build()
+    algo2.restore_from_path(path)
+    a = jax.tree.leaves(algo.wm)
+    b = jax.tree.leaves(algo2.wm)
+    assert all(np.allclose(x, y) for x, y in zip(a, b))
+    algo2.stop()

@@ -137,3 +137,52 @@ def test_arg_locality_prefers_data_node():
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+# ---------------------------------------------------------------------------
+# lease TTL expiry (r3 ADVICE: expiry must notify the owner)
+# ---------------------------------------------------------------------------
+
+def test_lease_expiry_then_worker_death_recovers(ray_boot):
+    """The r3 ADVICE hang: TTL expiry silently cleared w.lease_id, so a
+    subsequent worker death never sent lease_broken to the owner and its
+    enqueue-acked in-flight push hung forever. Now expiry itself sends
+    lease_broken (and the worker rejects stale pushes), so the owner
+    resubmits and the task completes."""
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2)
+    def slow():
+        time.sleep(8)
+        return "done"
+
+    ref = slow.remote()
+    from ray_tpu.core.api import _global_runtime
+
+    rt = _global_runtime()
+    nodelet = rt._booted[1]
+    # wait for the lease grant, then force-expire it mid-flight
+    pid = None
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        with nodelet._lock:
+            if nodelet._leases:
+                for le in nodelet._leases.values():
+                    le.expiry = 0.0
+                    pid = le.worker.proc.pid
+                break
+        time.sleep(0.05)
+    assert pid is not None, "no lease ever granted"
+    # wait for the reap loop to expire it (sends lease_broken now)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        with nodelet._lock:
+            if not nodelet._leases:
+                break
+        time.sleep(0.05)
+    # kill the worker: pre-fix, no lease_broken was ever sent and this hung
+    import signal
+
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+    assert ray_tpu.get(ref, timeout=60) == "done"

@@ -1,0 +1,94 @@
+"""Multi-process × dcn-mesh end to end (VERDICT r3 item 6).
+
+The 8→256-chip shape in miniature: 2 jax PROCESSES (jax.distributed
+rendezvous through the WorkerGroup) × 4 virtual devices each, a hybrid
+dcn×(data,fsdp,tensor) mesh whose dcn axis crosses the process
+boundary, slice-gang placement from TPU labels — with loss parity
+against the same global computation in ONE process (SURVEY §7 stage 7).
+"""
+
+import sys
+
+import cloudpickle
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core import tpu as tpu_mod
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+def _slice_labels(slice_name, worker_id, pod_type="v4-8"):
+    return {
+        tpu_mod.SLICE_LABEL: slice_name,
+        tpu_mod.WORKER_ID_LABEL: str(worker_id),
+        tpu_mod.POD_TYPE_LABEL: pod_type,
+    }
+
+
+@pytest.fixture(scope="module")
+def slice_cluster():
+    """One fake slice x two hosts (TPU:4 each) + a CPU head."""
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    for wid in (0, 1):
+        c.add_node(num_cpus=4, num_tpus=4,
+                   labels=_slice_labels("slice-dcn", wid))
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _dcn_loop(config):
+    """One hybrid-dcn train step; reports the loss and the world facts
+    the assertions need."""
+    import os
+
+    import jax
+
+    import ray_tpu.train as train
+    import __graft_entry__ as graft
+
+    ctx = train.get_context()
+    expect_procs = config["expect_procs"]
+    assert jax.process_count() == expect_procs, jax.process_count()
+    assert len(jax.devices()) == 8  # global across both processes
+    loss = graft._hybrid_dcn_step_loss()
+    train.report({
+        "loss": loss,
+        "rank": ctx.get_world_rank(),
+        "n_procs": jax.process_count(),
+        "hostnames": len(os.environ.get("TPU_WORKER_HOSTNAMES",
+                                        "").split(",")),
+    })
+
+
+def test_two_process_dcn_matches_single_process(slice_cluster, tmp_path):
+    losses = {}
+    for n_workers, devs in ((2, 4), (1, 8)):
+        trainer = JaxTrainer(
+            _dcn_loop,
+            train_loop_config={"expect_procs": n_workers},
+            scaling_config=ScalingConfig(
+                num_workers=n_workers,
+                use_tpu=(n_workers == 2),
+                num_cpu_devices_per_worker=devs,
+                resources_per_worker={"CPU": 1.0, "TPU": 4.0}
+                if n_workers == 2 else {"CPU": 1.0},
+                placement_strategy="STRICT_PACK"),
+            run_config=RunConfig(name=f"dcn{n_workers}",
+                                 storage_path=str(tmp_path)),
+        )
+        result = trainer.fit()
+        m = result.metrics_history[-1]
+        losses[n_workers] = m["loss"]
+        if n_workers == 2:
+            # slice-gang placement engaged: the slice topology env was
+            # derived from the labels (one hostname per gang member)
+            assert m["hostnames"] == 2
+    assert np.isfinite(losses[1]) and losses[1] > 0
+    np.testing.assert_allclose(losses[1], losses[2], rtol=1e-4, atol=1e-5)

@@ -177,3 +177,28 @@ def test_pubsub_long_poll_subscriber(cluster):
     assert [m["data"]["i"] for m in got["messages"]] == [99]
     rt.client.call(head, "unsubscribe",
                    {"subscriber_id": "test-sub-1"}, timeout=10)
+
+
+def test_state_list_objects_and_memory_summary(cluster):
+    """state.list_objects covers worker-owned objects too (a borrower
+    chain: driver owns the produced ref; the worker's own table shows
+    during execution) and memory_summary aggregates stores."""
+    import numpy as np
+
+    from ray_tpu.util import state
+
+    @ray_tpu.remote(num_cpus=0.1)
+    def produce():
+        return np.ones(512 * 1024, np.uint8)
+
+    refs = [produce.remote() for _ in range(3)]
+    ray_tpu.get(refs, timeout=60)
+    objs = state.list_objects()
+    ids = {o["object_id"] for o in objs}
+    assert all(r.id.hex() in ids for r in refs)
+    s = state.memory_summary()
+    assert s["objects_total"] >= 3
+    assert s["objects_bytes"] >= 3 * 512 * 1024
+    assert any(n["store_bytes_allocated"] > 0 for n in s["nodes"])
+    report = state.memory_report()
+    assert "object store per node" in report and "owned objects" in report

