@@ -172,6 +172,7 @@ class GCPTPUNodeProvider(NodeProvider):
         self._lock = threading.Lock()
         self._counter = 0
         self._pending: dict[str, dict] = {}  # slice -> node_type spec
+        self._booting: dict[str, dict] = {}  # claimed by a poll(), booting
         self._slices: dict[str, list[_SliceHost]] = {}
         self.failed_slices: list[str] = []
 
@@ -193,6 +194,7 @@ class GCPTPUNodeProvider(NodeProvider):
         with self._lock:
             hosts = self._slices.pop(name, [])
             self._pending.pop(name, None)
+            self._booting.pop(name, None)
         for h in hosts:  # whole-slice teardown, worker order irrelevant
             try:
                 h.nodelet.stop()
@@ -205,7 +207,7 @@ class GCPTPUNodeProvider(NodeProvider):
         with self._lock:
             for hosts in self._slices.values():
                 out.extend(hosts)
-            for name, spec in self._pending.items():
+            for name, spec in {**self._pending, **self._booting}.items():
                 n_hosts, _ = slice_shape(spec["accelerator_type"])
                 out.extend(_PendingHost(name) for _ in range(n_hosts))
         return out
@@ -237,12 +239,20 @@ class GCPTPUNodeProvider(NodeProvider):
                 continue
             if qr["state"] != ACTIVE:
                 continue
+            # CLAIM the slice under the lock BEFORE booting: concurrent
+            # poll() callers (autoscaler loop + any non_terminated_nodes
+            # caller) would otherwise each boot N nodelets and leak the
+            # loser's set under duplicate slice/worker-id labels
+            with self._lock:
+                if self._pending.pop(name, None) is None:
+                    continue  # another poll() claimed it
+                self._booting[name] = spec  # still counted as capacity
             hosts = []
             for h in qr["hosts"]:
                 hosts.append(self._boot_host(name, spec, qr, h))
             with self._lock:
+                self._booting.pop(name, None)
                 self._slices[name] = hosts
-                self._pending.pop(name, None)
 
     def _boot_host(self, slice_name: str, spec: dict, qr: dict,
                    host: dict) -> _SliceHost:

@@ -585,38 +585,49 @@ def read_datasource(datasource, *,
     return Dataset(refs, [_ReadOp(lambda block: block[0]())])
 
 
-def read_text(paths) -> Dataset:
+def _read_files(source_cls, paths, parallelism, *args):
+    """File read_* share one recipe: default parallelism is ONE task
+    per file (the natural split unit — a 1000-file directory must not
+    collapse to 8 serial readers); an explicit value groups files."""
+    ds = source_cls(paths, *args)
+    return read_datasource(
+        ds, parallelism=parallelism if parallelism is not None
+        else max(1, len(ds.paths)))
+
+
+def read_text(paths, *, parallelism: int | None = None) -> Dataset:
     """One row per line (reference: ray.data.read_text). The line
     splitting runs in the native mmap scanner (data/lineio.py ->
     _native/lineio.cc) inside the read task."""
     from ray_tpu.data.datasource import TextDatasource
 
-    return read_datasource(TextDatasource(paths))
+    return _read_files(TextDatasource, paths, parallelism)
 
 
-def read_csv(paths) -> Dataset:
+def read_csv(paths, *, parallelism: int | None = None) -> Dataset:
     """Dict rows from CSV with a header (reference: ray.data.read_csv;
     stdlib csv instead of Arrow)."""
     from ray_tpu.data.datasource import CSVDatasource
 
-    return read_datasource(CSVDatasource(paths))
+    return _read_files(CSVDatasource, paths, parallelism)
 
 
-def read_json(paths) -> Dataset:
+def read_json(paths, *, parallelism: int | None = None) -> Dataset:
     """JSONL rows (reference: ray.data.read_json)."""
     from ray_tpu.data.datasource import JSONLDatasource
 
-    return read_datasource(JSONLDatasource(paths))
+    return _read_files(JSONLDatasource, paths, parallelism)
 
 
-def read_parquet(paths, columns: list[str] | None = None) -> Dataset:
+def read_parquet(paths, columns: list[str] | None = None, *,
+                 parallelism: int | None = None) -> Dataset:
     """Columnar parquet read — one Arrow table per file, read inside
     tasks (reference: ray.data.read_parquet backed by
     data/_internal/arrow_block.py). Rows surface as dicts; use
     map_batches(batch_format="pyarrow") to stay columnar."""
     from ray_tpu.data.datasource import ParquetDatasource
 
-    return read_datasource(ParquetDatasource(paths, columns))
+    return _read_files(ParquetDatasource, paths, parallelism, columns)
 
 
 def from_arrow(table, parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:

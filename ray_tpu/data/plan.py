@@ -193,20 +193,15 @@ class LogicalPlan:
         return LogicalPlan(ops)
 
     def compile(self) -> Callable[[Block], Block]:
-        """Physical form: one fused per-block callable."""
+        """Physical form: one fused per-block callable. (With the
+        default rules MapFusion already collapsed chains; Fused covers
+        any custom rule set that leaves several operators.)"""
         ops = self.optimized().ops
         if not ops:
             return lambda b: b
         if len(ops) == 1:
             return ops[0].block_fn()
-        fns = [op.block_fn() for op in ops]
-
-        def chain(b):
-            for f in fns:
-                b = f(b)
-            return b
-
-        return chain
+        return Fused(tuple(ops)).block_fn()
 
     def global_limit(self) -> int | None:
         """The plan's overall row cap, if its SUFFIX is only limits and

@@ -43,13 +43,15 @@ class StepNode:
     workflow step decorator / DAG node bind)."""
 
     def __init__(self, fn, name: str, args: tuple, kwargs: dict,
-                 max_retries: int = 0, num_cpus: float = 1.0):
+                 max_retries: int = 0, num_cpus: float = 1.0,
+                 timeout_s: float | None = None):
         self.fn = fn
         self.name = name
         self.args = args
         self.kwargs = kwargs
         self.max_retries = max_retries
         self.num_cpus = num_cpus
+        self.timeout_s = timeout_s  # None = wait as long as the step runs
 
     def step_id(self) -> str:
         """Deterministic content-addressed id: the step's name plus the
@@ -82,6 +84,21 @@ class StepNode:
                     h.update(repr(v).encode())
 
         h = hashlib.sha1(self.name.encode())
+        # the FUNCTION is part of the identity: same-named steps with
+        # different bodies (or a body edited between run and resume)
+        # must not reuse each other's persisted results
+        fn = self.fn
+        h.update(getattr(fn, "__module__", "").encode())
+        h.update(getattr(fn, "__qualname__", "").encode())
+        code = getattr(fn, "__code__", None)
+        if code is not None:
+            h.update(code.co_code)
+            h.update(repr(code.co_consts).encode())
+        for cell in getattr(fn, "__closure__", None) or ():
+            try:
+                h.update(cloudpickle.dumps(cell.cell_contents))
+            except Exception:  # noqa: BLE001
+                h.update(repr(cell.cell_contents).encode())
         for a in self.args:
             feed(h, a)
         for k in sorted(self.kwargs):
@@ -92,32 +109,38 @@ class StepNode:
 
 
 class _StepFunction:
-    def __init__(self, fn, name=None, max_retries=0, num_cpus=1.0):
+    def __init__(self, fn, name=None, max_retries=0, num_cpus=1.0,
+                 timeout_s=None):
         self._fn = fn
         self._name = name or fn.__name__
         self._max_retries = max_retries
         self._num_cpus = num_cpus
+        self._timeout_s = timeout_s
 
     def step(self, *args, **kwargs) -> StepNode:
         return StepNode(self._fn, self._name, args, kwargs,
-                        self._max_retries, self._num_cpus)
+                        self._max_retries, self._num_cpus,
+                        self._timeout_s)
 
     def options(self, **kw) -> "_StepFunction":
         return _StepFunction(self._fn, kw.get("name", self._name),
                              kw.get("max_retries", self._max_retries),
-                             kw.get("num_cpus", self._num_cpus))
+                             kw.get("num_cpus", self._num_cpus),
+                             kw.get("timeout_s", self._timeout_s))
 
     def __call__(self, *a, **kw):
         return self._fn(*a, **kw)
 
 
 def step(_fn=None, *, name: str | None = None, max_retries: int = 0,
-         num_cpus: float = 1.0):
+         num_cpus: float = 1.0, timeout_s: float | None = None):
     """Decorator: make a function a workflow step (reference:
-    workflow step API)."""
+    workflow step API). `timeout_s` bounds ONE execution of the step;
+    the default (None) waits as long as the step runs — durable DAGs
+    exist precisely for long jobs."""
 
     def wrap(fn):
-        return _StepFunction(fn, name, max_retries, num_cpus)
+        return _StepFunction(fn, name, max_retries, num_cpus, timeout_s)
 
     return wrap(_fn) if _fn is not None else wrap
 
@@ -202,7 +225,8 @@ def _execute(node: StepNode, storage: _Storage, stats: dict) -> Any:
 
     task = ray_tpu.remote(num_cpus=node.num_cpus,
                           max_retries=node.max_retries)(node.fn)
-    value = ray_tpu.get(task.remote(*args, **kwargs), timeout=600)
+    value = ray_tpu.get(task.remote(*args, **kwargs),
+                        timeout=node.timeout_s)
     storage.save_step(sid, value)
     stats["executed"] += 1
     return value

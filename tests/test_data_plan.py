@@ -131,3 +131,20 @@ def test_custom_datasource_contract():
 def test_missing_files_error():
     with pytest.raises(FileNotFoundError):
         TextDatasource("/definitely/not/here/*.txt")
+
+
+def test_read_parallelism_defaults_to_one_task_per_file(tmp_path):
+    import ray_tpu
+    from ray_tpu import data as rd
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        for i in range(12):
+            (tmp_path / f"f{i}.txt").write_text(f"{i}\n")
+        ds = rd.read_text(str(tmp_path))
+        assert ds.num_blocks() == 12  # one task per file by default
+        ds2 = rd.read_text(str(tmp_path), parallelism=3)
+        assert ds2.num_blocks() == 3
+        assert sorted(ds2.take_all()) == sorted(str(i) for i in range(12))
+    finally:
+        ray_tpu.shutdown()

@@ -160,3 +160,37 @@ def test_fan_in_steps_nested_in_containers(cluster, tmp_path):
     dag2 = total.step([const.step(1), const.step(2), const.step(3)],
                       {"extra": const.step(10)})
     assert dag.step_id() == dag2.step_id()
+
+
+def test_step_identity_includes_function_body(cluster, tmp_path):
+    """Two same-named steps with different bodies must not share
+    persisted results (fn code is part of the step id)."""
+
+    def make(ret):
+        @workflow.step(name="load")
+        def load():
+            return ret
+
+        return load
+
+    a, b = make("A"), make("B")
+    assert a.step().step_id() != b.step().step_id()
+    assert workflow.run(a.step(), workflow_id="ida",
+                        storage=str(tmp_path)) == "A"
+    assert workflow.run(b.step(), workflow_id="ida",
+                        storage=str(tmp_path)) == "B"  # no stale reuse
+
+
+def test_step_timeout_option(cluster, tmp_path):
+    import time as _t
+
+    @workflow.step(timeout_s=1.0, max_retries=0)
+    def slow():
+        _t.sleep(30)
+        return 1
+
+    with pytest.raises(Exception):
+        workflow.run(slow.step(), workflow_id="slowwf",
+                     storage=str(tmp_path))
+    assert workflow.get_status("slowwf", storage=str(tmp_path)) == \
+        workflow.RESUMABLE
