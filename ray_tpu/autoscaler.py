@@ -72,6 +72,29 @@ class FakeNodeProvider(NodeProvider):
         return handle.node_id
 
 
+def compute_demand(alive_nodes: list[dict], pgs: list[dict]) -> bool:
+    """The scale-up signal shared by the v1 loop and the v2 scheduler:
+    queued work with no CPU headroom, or an unplaceable PENDING
+    placement group."""
+    total_queued = sum(n.get("queue_len", 0) for n in alive_nodes)
+    headroom = sum(n.get("available", {}).get("CPU", 0.0)
+                   for n in alive_nodes)
+    pending_pgs = any(g.get("state") == "PENDING" for g in pgs)
+    return (total_queued > 0 and headroom < 1.0) or pending_pgs
+
+
+def idle_node_ids(alive_nodes: list[dict]) -> set:
+    """Nodes with an empty queue and FULL availability. Tolerance
+    compare: fractional acquire/release sequences can leave 1e-16-scale
+    residue that exact equality never matches."""
+    return {
+        n["node_id"] for n in alive_nodes
+        if n.get("queue_len", 0) == 0 and all(
+            abs(n.get("available", {}).get(r, 0.0) - q) < 1e-6
+            for r, q in n.get("resources", {}).items())
+    }
+
+
 @dataclasses.dataclass
 class AutoscalerConfig:
     min_workers: int = 0
@@ -120,17 +143,9 @@ class StandardAutoscaler:
         except Exception:  # noqa: BLE001
             return
         alive = [n for n in view if n["alive"]]
-        total_queued = sum(n.get("queue_len", 0) for n in alive)
-        headroom = {}
-        for n in alive:
-            for r, q in n.get("available", {}).items():
-                headroom[r] = headroom.get(r, 0.0) + q
-        pending_pgs = any(g.get("state") == "PENDING" for g in pgs)
         managed = self.provider.non_terminated_nodes()
 
-        # scale up: queued work with no CPU headroom, or unplaceable PGs
-        want_up = (total_queued > 0 and headroom.get("CPU", 0.0) < 1.0) \
-            or pending_pgs
+        want_up = compute_demand(alive, pgs)
         if want_up and len(managed) < cfg.max_workers:
             n_new = min(cfg.upscaling_speed,
                         cfg.max_workers - len(managed))
@@ -149,6 +164,7 @@ class StandardAutoscaler:
         except Exception:  # noqa: BLE001
             return
         by_id = {n["node_id"]: n for n in view}
+        idle_ids = idle_node_ids([n for n in view if n["alive"]])
         now = time.monotonic()
         managed = self.provider.non_terminated_nodes()
         for handle in managed:
@@ -158,13 +174,7 @@ class StandardAutoscaler:
             n = by_id.get(nid)
             if n is None or not n["alive"]:
                 continue
-            avail = n.get("available", {})
-            total = n.get("resources", {})
-            # tolerance compare: fractional acquire/release sequences can
-            # leave 1e-16-scale residue that exact equality never matches
-            idle = (n.get("queue_len", 0) == 0 and all(
-                abs(avail.get(r, 0.0) - q) < 1e-6 for r, q in total.items()))
-            if not idle:
+            if nid not in idle_ids:
                 self._idle_since.pop(nid, None)
                 continue
             t0 = self._idle_since.setdefault(nid, now)

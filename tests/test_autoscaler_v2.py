@@ -1,0 +1,238 @@
+"""Autoscaler v2 — instance lifecycle + reconciler (reference model:
+python/ray/autoscaler/v2/tests — state-machine legality, idempotent
+reconciliation, stuck-instance handling, demand-driven convergence)."""
+
+import sys
+import time
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import FakeNodeProvider
+from ray_tpu.autoscaler_v2 import (
+    ALLOCATED,
+    ALLOCATION_FAILED,
+    QUEUED,
+    RAY_RUNNING,
+    REQUESTED,
+    TERMINATED,
+    TERMINATING,
+    InstanceStorage,
+    InvalidTransitionError,
+    Reconciler,
+)
+from ray_tpu.cluster_utils import Cluster
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+# ---------------------------------------------------------------------------
+# storage state machine
+# ---------------------------------------------------------------------------
+
+def test_legal_lifecycle_and_history():
+    st = InstanceStorage()
+    inst = st.add("worker")
+    assert inst.status == QUEUED
+    st.transition(inst.instance_id, REQUESTED)
+    st.transition(inst.instance_id, ALLOCATED, node_id=b"n1")
+    st.transition(inst.instance_id, RAY_RUNNING)
+    st.transition(inst.instance_id, TERMINATING)
+    got = st.transition(inst.instance_id, TERMINATED)
+    assert [s for s, _ in got.history] == [
+        QUEUED, REQUESTED, ALLOCATED, RAY_RUNNING, TERMINATING, TERMINATED]
+
+
+def test_illegal_edges_raise():
+    st = InstanceStorage()
+    inst = st.add("worker")
+    with pytest.raises(InvalidTransitionError):
+        st.transition(inst.instance_id, RAY_RUNNING)  # QUEUED -> RUNNING
+    st.transition(inst.instance_id, REQUESTED)
+    st.transition(inst.instance_id, ALLOCATION_FAILED)
+    with pytest.raises(InvalidTransitionError):
+        st.transition(inst.instance_id, REQUESTED)  # terminal
+
+
+def test_version_cas_conflict():
+    st = InstanceStorage()
+    inst = st.add("worker")
+    v = inst.version
+    st.transition(inst.instance_id, REQUESTED, expected_version=v)
+    with pytest.raises(InvalidTransitionError):
+        st.transition(inst.instance_id, ALLOCATED, expected_version=v)
+
+
+def test_subscribers_see_every_transition():
+    st = InstanceStorage()
+    seen = []
+    st.subscribe(lambda i: seen.append(i.status))
+    inst = st.add("worker")
+    st.transition(inst.instance_id, REQUESTED)
+    assert seen == [QUEUED, REQUESTED]
+
+
+# ---------------------------------------------------------------------------
+# reconciler against a live head + fake provider
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _mk(cluster, tmp_path, **kw):
+    provider = FakeNodeProvider(
+        cluster.address, {"worker": {"resources": {"CPU": 4.0}}},
+        session_dir=str(tmp_path / "v2"))
+    return Reconciler(cluster.address, provider, node_type="worker", **kw)
+
+
+def test_scale_up_converges_to_ray_running(cluster, tmp_path):
+    rec = _mk(cluster, tmp_path, min_workers=1, max_workers=3)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        rec.reconcile()
+        if rec.storage.list(RAY_RUNNING):
+            break
+        time.sleep(0.2)
+    running = rec.storage.list(RAY_RUNNING)
+    assert len(running) == 1
+    assert running[0].node_id is not None
+    assert rec.summary()["launches"] == 1
+    # idempotence: further ticks change nothing at steady state
+    for _ in range(3):
+        rec.reconcile()
+    assert rec.summary()["launches"] == 1
+    assert len(rec.storage.list(RAY_RUNNING)) == 1
+
+
+def test_demand_drives_scale_up_then_idle_scale_down(cluster, tmp_path):
+    rec = _mk(cluster, tmp_path, min_workers=0, max_workers=2,
+              idle_timeout_s=1.5)
+
+    @ray_tpu.remote(num_cpus=1)
+    def slow():
+        time.sleep(1.0)
+        return 1
+
+    refs = [slow.remote() for _ in range(6)]  # 1-CPU head: queue builds
+    deadline = time.monotonic() + 40
+    while time.monotonic() < deadline:
+        rec.reconcile()
+        if rec.storage.list(RAY_RUNNING):
+            break
+        time.sleep(0.2)
+    assert rec.storage.list(RAY_RUNNING), "no scale-up under demand"
+    assert ray_tpu.get(refs, timeout=120) == [1] * 6
+
+    # reclaim: RAY_RUNNING → TERMINATING → (provider+head agree it is
+    # gone, head death-detection ~5s) → TERMINATED
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        rec.reconcile()
+        if rec.storage.list(TERMINATED):
+            break
+        time.sleep(0.3)
+    assert not rec.storage.list(RAY_RUNNING), "idle node never reclaimed"
+    assert rec.storage.list(TERMINATED), "termination never converged"
+    assert rec.summary()["terminations"] >= 1
+
+
+def test_stuck_requested_instance_reclaimed_not_leaked(cluster, tmp_path):
+    """A stuck REQUESTED whose provider call SUCCEEDED must be
+    terminated (the cloud node may materialize later and bill forever
+    behind a terminal record), not marked ALLOCATION_FAILED."""
+    terminated = []
+
+    class StuckProvider(FakeNodeProvider):
+        def create_node(self, node_type):
+            return object()  # a handle that never yields a node_id
+
+        def node_id(self, handle):
+            return b""
+
+        def terminate_node(self, handle):
+            terminated.append(handle)
+
+    provider = StuckProvider(
+        cluster.address, {"worker": {"resources": {"CPU": 2.0}}},
+        session_dir=str(tmp_path / "stuck"))
+    rec = Reconciler(cluster.address, provider, node_type="worker",
+                     min_workers=1, max_workers=2,
+                     stuck_timeouts={"REQUESTED": 0.5})
+    rec.reconcile()
+    assert rec.storage.list(REQUESTED)
+    time.sleep(0.7)
+    rec.reconcile()  # stuck → TERMINATING (terminate issued)
+    rec.reconcile()  # provider agrees it is gone → TERMINATED
+    assert terminated, "stuck launch never terminated at the provider"
+    assert rec.storage.list(TERMINATED)
+    assert not rec.storage.list(ALLOCATION_FAILED)
+    # a partial stuck_timeouts override must keep the other defaults
+    assert "ALLOCATED" in rec.stuck_timeouts
+    assert "TERMINATING" in rec.stuck_timeouts
+
+
+def test_provider_create_failure_records_allocation_failed(cluster,
+                                                          tmp_path):
+    class FailingProvider(FakeNodeProvider):
+        def create_node(self, node_type):
+            raise RuntimeError("stockout")
+
+    provider = FailingProvider(
+        cluster.address, {"worker": {"resources": {"CPU": 2.0}}},
+        session_dir=str(tmp_path / "fail"))
+    rec = Reconciler(cluster.address, provider, node_type="worker",
+                     min_workers=1, max_workers=2)
+    rec.reconcile()
+    assert rec.storage.list(ALLOCATION_FAILED)
+    assert not rec.storage.list(RAY_RUNNING)
+
+
+def test_gcp_slice_adoption(cluster, tmp_path):
+    """One GCP create_node yields N slice hosts; the reconciler matches
+    the requesting instance to one host and ADOPTS the others as
+    managed instances (reference: reconciler cloud-instance adoption)."""
+    from ray_tpu.autoscaler_gcp import GCPTPUNodeProvider
+
+    provider = GCPTPUNodeProvider(
+        cluster.address,
+        {"tpu": {"accelerator_type": "v4-8", "cpus_per_host": 1}},
+        session_dir=str(tmp_path / "gcpv2"))
+    rec = Reconciler(cluster.address, provider, node_type="tpu",
+                     min_workers=1, max_workers=4)
+    deadline = time.monotonic() + 40
+    while time.monotonic() < deadline:
+        rec.reconcile()
+        if len(rec.storage.list(RAY_RUNNING)) >= 2:
+            break
+        time.sleep(0.3)
+    running = rec.storage.list(RAY_RUNNING)
+    assert len(running) == 2, rec.summary()  # both v4-8 hosts managed
+    assert len({i.node_id for i in running}) == 2
+    assert rec.summary()["launches"] == 1  # ONE provider request
+    for h in list(provider.non_terminated_nodes()):
+        provider.terminate_node(h)
+
+
+def test_stockout_backoff_bounds_failed_records(cluster, tmp_path):
+    class FailingProvider(FakeNodeProvider):
+        def create_node(self, node_type):
+            raise RuntimeError("stockout")
+
+    provider = FailingProvider(
+        cluster.address, {"worker": {"resources": {"CPU": 2.0}}},
+        session_dir=str(tmp_path / "stockout"))
+    rec = Reconciler(cluster.address, provider, node_type="worker",
+                     min_workers=1, max_workers=2)
+    for _ in range(10):
+        rec.reconcile()
+    # backoff: 10 ticks produce ONE failed record, not ten
+    assert len(rec.storage.list(ALLOCATION_FAILED)) == 1
