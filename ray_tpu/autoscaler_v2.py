@@ -97,7 +97,8 @@ class InstanceStorage:
             inst.version = self._version
             inst.history.append((QUEUED, time.monotonic()))
             self._instances[inst.instance_id] = inst
-        self._notify(inst)
+            snap = self._snapshot(inst)
+        self._notify(snap)
         return inst
 
     def transition(self, instance_id: str, new_status: str,
@@ -123,14 +124,19 @@ class InstanceStorage:
                 setattr(inst, k, v)
             self._version += 1
             inst.version = self._version
-        self._notify(inst)
+            snap = self._snapshot(inst)
+        self._notify(snap)
         return inst
 
-    def _notify(self, inst: Instance):
-        # subscribers get an immutable SNAPSHOT (taken under the caller's
-        # lock window): the live record keeps mutating, and cross-thread
-        # delivery order is best-effort — consumers sort by .version
-        snap = dataclasses.replace(inst, history=list(inst.history))
+    @staticmethod
+    def _snapshot(inst: Instance) -> Instance:
+        """Immutable copy built UNDER the storage lock, so a concurrent
+        transition can never tear the payload a subscriber receives.
+        Cross-thread delivery order remains best-effort — consumers
+        sort by .version."""
+        return dataclasses.replace(inst, history=list(inst.history))
+
+    def _notify(self, snap: Instance):
         for fn in self._subscribers:
             try:
                 fn(snap)
@@ -303,6 +309,13 @@ class Reconciler:
         for inst in self.storage.list(ALLOCATED):
             if inst.node_id in by_node_id:
                 self.storage.transition(inst.instance_id, RAY_RUNNING)
+        # 2b. sync: RAY_RUNNING instances whose node DIED (crash,
+        # preemption, or a whole-slice terminate taking sibling hosts):
+        # without this they count as live forever and min_workers
+        # replacement never fires
+        for inst in self.storage.list(RAY_RUNNING):
+            if inst.node_id not in by_node_id:
+                self._terminate(inst)  # step 3 completes it next tick
         # 3. sync: TERMINATING instances gone from the provider
         for inst in self.storage.list(TERMINATING):
             if inst.node_id not in provider_nodes and \
@@ -356,11 +369,15 @@ class Reconciler:
                     self.storage.transition(inst.instance_id, REQUESTED)
                     self.storage.transition(inst.instance_id,
                                             ALLOCATION_FAILED)
+                    # stop the WHOLE tick's launches: hammering a
+                    # stocked-out provider mints a failure per attempt
                     self._launch_backoff_until = now + 10.0
-                    continue
+                    break
                 self.storage.transition(inst.instance_id, REQUESTED,
                                         provider_handle=handle)
                 self.num_launches += 1
+            if now < self._launch_backoff_until:
+                break
         # 7. apply: terminations
         for iid in decision.to_terminate:
             inst = self.storage.get(iid)

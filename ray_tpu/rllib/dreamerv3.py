@@ -13,8 +13,10 @@ losses — is ONE jitted program; the RSSM scans are `lax.scan`s that
 XLA unrolls onto the MXU, and the imagination rollout never leaves the
 device.
 
-Scope: vector observations (the test env class); image encoders plug in
-through the same catalog seam as the rest of rllib (catalog.py).
+Observations: vectors (symlog MLP encoder + symlog-MSE decoder) AND
+images (catalog conv encoder over [-0.5, 0.5]-scaled pixels + dense
+pixel decoder — proportionate to the MinAtar-scale grids this image
+can host; the reference's 64x64 Atari decoder is a deconv stack).
 """
 
 from __future__ import annotations
@@ -210,16 +212,29 @@ class DreamerV3(Checkpointable):
         self.n_cat, self.n_cls = d["n_cat"], d["n_cls"]
         stoch = self.n_cat * self.n_cls
 
+        from ray_tpu.rllib import envs as _envs
+
+        _envs.register_envs()
         self.envs = gym.make_vec(cfg.env, num_envs=cfg.num_envs)
-        self.obs_dim = int(np.prod(self.envs.single_observation_space.shape))
+        obs_shape = tuple(self.envs.single_observation_space.shape)
+        self._obs_shape = obs_shape
+        self._image_obs = len(obs_shape) == 3  # catalog.is_image rule
+        self.obs_dim = int(np.prod(obs_shape))
         self.n_actions = int(self.envs.single_action_space.n)
         A, O = self.n_actions, self.obs_dim
 
         key = jax.random.PRNGKey(cfg.seed)
         ks = jax.random.split(key, 12)
+        if self._image_obs:
+            from ray_tpu.rllib.catalog import init_conv_encoder
+
+            encoder, _ = init_conv_encoder(ks[0], obs_shape,
+                                           out_dim=units)
+        else:
+            encoder = _dense_init(ks[0], (O, units, units))
         # world model (reference: dreamerv3_rl_module.py components)
         self.wm = {
-            "encoder": _dense_init(ks[0], (O, units, units)),
+            "encoder": encoder,
             "gru_in": _dense_init(ks[1], (stoch + A, units)),
             "gru": _gru_init(ks[2], units, deter),
             "prior": _dense_init(ks[3], (deter, units, stoch)),
@@ -270,13 +285,28 @@ class DreamerV3(Checkpointable):
     def _build_fns(self, deter, stoch, A):
         cfg = self.config
         n_cat, n_cls = self.n_cat, self.n_cls
+        image = self._image_obs
+
+        def prep(obs):
+            """Raw obs -> the encoder/decoder target space: pixels scale
+            to [-0.5, 0.5] (reference image preprocessing), vectors go
+            through symlog."""
+            obs = obs.astype(jnp.float32)
+            return obs / 255.0 - 0.5 if image else symlog(obs)
+
+        def encode(wm, obs):
+            if image:
+                from ray_tpu.rllib.catalog import apply_conv_encoder
+
+                return apply_conv_encoder(wm["encoder"], obs)
+            return _mlp(wm["encoder"], obs, out_act=True)
 
         def obs_step(wm, key, h, z, a_onehot, obs):
-            """One posterior RSSM step with real obs."""
+            """One posterior RSSM step with real (preprocessed) obs."""
             x = _mlp(wm["gru_in"], jnp.concatenate([z, a_onehot], -1),
                      out_act=True)
             h = _gru(wm["gru"], h, x)
-            emb = _mlp(wm["encoder"], obs, out_act=True)
+            emb = encode(wm, obs)
             post_logits = _mlp(wm["post"], jnp.concatenate([h, emb], -1))
             prior_logits = _mlp(wm["prior"], h)
             z, _ = self._latent(wm, key, post_logits)
@@ -322,7 +352,7 @@ class DreamerV3(Checkpointable):
 
             a_prev = jnp.concatenate([jnp.zeros_like(a_oh[:, :1]),
                                       a_oh[:, :-1]], axis=1)
-            enc_in = symlog(batch["obs"])  # encoder + decoder target space
+            enc_in = prep(batch["obs"])  # encoder + decoder target space
             (_, _), (hs, zs, post_l, prior_l) = jax.lax.scan(
                 scan_fn, (h0, z0),
                 (keys, enc_in.swapaxes(0, 1),
@@ -334,7 +364,7 @@ class DreamerV3(Checkpointable):
 
             recon = _mlp(wm["decoder"], feat)
             l_dec = jnp.mean(jnp.sum(
-                (recon - symlog(batch["obs"])) ** 2, -1))
+                (recon - enc_in.reshape(B, T, -1)) ** 2, -1))
             r_logits = _mlp(wm["reward"], feat)
             l_rew = -jnp.mean(jnp.sum(
                 twohot(batch["rewards"]) * jax.nn.log_softmax(r_logits), -1))
@@ -464,7 +494,7 @@ class DreamerV3(Checkpointable):
         def act(wm, actor, key, h, z, obs, first):
             h = jnp.where(first[:, None], jnp.zeros_like(h), h)
             z = jnp.where(first[:, None], jnp.zeros_like(z), z)
-            emb = _mlp(wm["encoder"], symlog(obs), out_act=True)
+            emb = encode(wm, prep(obs))
             post_logits = _mlp(wm["post"], jnp.concatenate([h, emb], -1))
             kz, ka = jax.random.split(key)
             z, _ = self._latent(wm, kz, post_logits)
@@ -500,7 +530,9 @@ class DreamerV3(Checkpointable):
             # next-step autoreset: the step AFTER done carries the reset
             # obs with the action ignored — store it as a sequence start
             self.buffer.add_step({
-                "obs": np.asarray(self.obs, np.float32),
+                # native dtype: uint8 pixels stay uint8 in replay (4x
+                # smaller); prep() scales on device at train time
+                "obs": np.asarray(self.obs),
                 "actions": a,
                 "rewards": np.asarray(rew, np.float32),
                 "dones": np.asarray(term, np.float32),

@@ -236,3 +236,30 @@ def test_stockout_backoff_bounds_failed_records(cluster, tmp_path):
         rec.reconcile()
     # backoff: 10 ticks produce ONE failed record, not ten
     assert len(rec.storage.list(ALLOCATION_FAILED)) == 1
+
+
+def test_dead_ray_running_node_replaced(cluster, tmp_path):
+    """A RAY_RUNNING instance whose node dies must leave RAY_RUNNING
+    (via TERMINATING) so min_workers replacement fires — a crashed node
+    must not count as live capacity forever."""
+    rec = _mk(cluster, tmp_path, min_workers=1, max_workers=3)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        rec.reconcile()
+        if rec.storage.list(RAY_RUNNING):
+            break
+        time.sleep(0.2)
+    inst = rec.storage.list(RAY_RUNNING)[0]
+    inst.provider_handle.stop()  # kill the nodelet behind the provider's back
+    rec.provider._nodes.remove(inst.provider_handle)
+    deadline = time.monotonic() + 40
+    replaced = False
+    while time.monotonic() < deadline:
+        rec.reconcile()
+        running = rec.storage.list(RAY_RUNNING)
+        if running and running[0].instance_id != inst.instance_id:
+            replaced = True
+            break
+        time.sleep(0.3)
+    assert replaced, rec.summary()
+    assert rec.summary()["launches"] == 2
