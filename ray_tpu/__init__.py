@@ -25,6 +25,7 @@ python/ray/remote_function.py:41, python/ray/actor.py:602):
 from ray_tpu._version import __version__
 from ray_tpu.core.api import (
     ObjectRef,
+    ObjectRefGenerator,
     available_resources,
     cancel,
     cluster_resources,
@@ -46,6 +47,7 @@ from ray_tpu.core.api import (
 __all__ = [
     "__version__",
     "ObjectRef",
+    "ObjectRefGenerator",
     "available_resources",
     "cancel",
     "cluster_resources",

@@ -73,6 +73,95 @@ class ObjectRef:
         return asyncio.wrap_future(self.future()).__await__()
 
 
+class ObjectRefGenerator:
+    """Handle for a streaming-generator task (`num_returns="streaming"`).
+
+    Iterating yields one ObjectRef per value the remote generator yields,
+    AS the producer yields them — the consumer does not wait for the task
+    to finish (reference: ObjectRefStream,
+    src/ray/core_worker/task_manager.h:104 and the ObjectRefGenerator in
+    python/ray/_raylet.pyx). Picklable: a borrower process iterates by
+    asking the stream's owner for each index."""
+
+    __slots__ = ("_task_id", "_owner", "_index", "_done", "_handed_off",
+                 "__weakref__")
+
+    def __init__(self, task_id: bytes, owner: str):
+        self._task_id = task_id
+        self._owner = owner
+        self._index = 0
+        self._done = False
+        self._handed_off = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        return self._next_sync(None)
+
+    def _next_sync(self, timeout: float | None = None) -> "ObjectRef":
+        """Like __next__ but with a timeout (reference:
+        ObjectRefGenerator._next_sync)."""
+        if self._done:
+            raise StopIteration
+        try:
+            ref = _global_runtime().stream_next(
+                self._task_id, self._owner, self._index, timeout=timeout)
+        except StopIteration:
+            self._done = True
+            raise
+        self._index += 1
+        return ref
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        import asyncio
+
+        try:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, self.__next__)
+        except StopIteration:
+            raise StopAsyncIteration from None
+
+    def close(self):
+        """Early termination: tells the owner to drop unconsumed items
+        and cancel the producer (reference: stream deletion GC,
+        task_manager.h:212)."""
+        if self._done:
+            return
+        self._done = True
+        rt = _runtime
+        if rt is not None:
+            try:
+                rt.stream_close(self._task_id, self._owner)
+            except Exception:
+                pass
+
+    def __del__(self):
+        # a handle that was pickled away handed consumption to the
+        # borrower copy: closing here would silently truncate its
+        # iteration (the borrower's close/exhaustion does the GC instead)
+        if not self._handed_off:
+            self.close()
+
+    def __reduce__(self):
+        self._handed_off = True
+        g = (_rebuild_generator, (self._task_id, self._owner, self._index))
+        return g
+
+    def __repr__(self):
+        return (f"ObjectRefGenerator({self._task_id.hex()[:12]}…, "
+                f"index={self._index})")
+
+
+def _rebuild_generator(task_id: bytes, owner: str, index: int):
+    g = ObjectRefGenerator(task_id, owner)
+    g._index = index
+    return g
+
+
 # ---------------------------------------------------------------- init
 
 

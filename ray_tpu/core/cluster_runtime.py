@@ -68,6 +68,30 @@ class _Owned:
         self.cancelled = False
 
 
+class _StreamState:
+    """Owner-side bookkeeping for one streaming-generator task
+    (reference: ObjectRefStream, src/ray/core_worker/task_manager.h:104).
+
+    Items arrive as stream_item oneways from the producer (ZeroMQ orders
+    them before the terminating stream_end on the same connection); the
+    consumer — local generator handle or a remote borrower via the
+    stream_next RPC — blocks on `cond` for the next index. `consumed`
+    feeds producer backpressure."""
+
+    __slots__ = ("cond", "items", "end", "error", "consumed", "closed",
+                 "producer", "sentinel")
+
+    def __init__(self, sentinel: bytes):
+        self.cond = threading.Condition()
+        self.items: dict[int, bytes] = {}  # index -> item oid
+        self.end: int | None = None        # total count once producer done
+        self.error: BaseException | None = None
+        self.consumed = 0                  # indices handed to the consumer
+        self.closed = False
+        self.producer: str | None = None   # producer rpc address (cancel)
+        self.sentinel = sentinel           # return_oids[0] of the task
+
+
 class _Context(threading.local):
     def __init__(self):
         self.actor_id = None
@@ -182,11 +206,19 @@ class ClusterRuntime:
         self._last_renew = 0.0
         self._last_backlog = 0
 
+        # streaming-generator streams we own, keyed by producing task_id
+        self._streams: dict[bytes, _StreamState] = {}
         self.server = RpcServer(name=f"rt-{mode}", num_threads=32)
         self.server.register("lease_broken", self._h_lease_broken,
                              oneway=True)
         self.server.register("task_done", self._h_task_done, oneway=True)
         self.server.register("resolve", self._h_resolve)
+        self.server.register("stream_item", self._h_stream_item, oneway=True)
+        self.server.register("stream_end", self._h_stream_end, oneway=True)
+        self.server.register("stream_next", self._h_stream_next)
+        self.server.register("stream_state", self._h_stream_state)
+        self.server.register("stream_close", self._h_stream_close,
+                             oneway=True)
         self.server.register("borrow_release", self._h_borrow_release,
                              oneway=True)
         self.server.register("pubsub", self._h_pubsub, oneway=True)
@@ -919,6 +951,7 @@ class ClusterRuntime:
             retried = self._task_failed(oids, error, retryable)
             if not retried and task_id:
                 self._unpin_task_args(task_id)
+                self._stream_fail(task_id, error)
             return
         if task_id:
             self._unpin_task_args(task_id)
@@ -980,6 +1013,8 @@ class ClusterRuntime:
             if st is not None and not st.event.is_set():
                 st.error = error
                 st.event.set()
+        if spec is not None:
+            self._stream_fail(spec.task_id, error)
         return False
 
     def _h_pubsub(self, msg, frames):
@@ -1000,12 +1035,298 @@ class ClusterRuntime:
                         self._task_actor.pop(tid, None)
                 cause = data.get("cause", "actor died")
                 for tid, oids in pend.items():
-                    self._error_oids(
-                        oids, exc.ActorDiedError(
-                            f"actor died with call in flight: {cause}"))
+                    err = exc.ActorDiedError(
+                        f"actor died with call in flight: {cause}")
+                    self._error_oids(oids, err)
+                    self._stream_fail(tid, err)
                     self._unpin_task_args(tid)
             if data["event"] == "dead":
                 self._unpin_task_args(aid)
+
+    # ------------------------------------------------------------ streams
+    # Owner side of num_returns="streaming" (reference: ObjectRefStream +
+    # stream bookkeeping in the TaskManager, core_worker/task_manager.h:
+    # 104,212). Items are real owned objects (inline bytes or a store
+    # location) registered as they arrive, so borrowers resolve them via
+    # the ordinary ownership protocol; the stream adds only the index →
+    # oid order book, end/error markers, and consumer progress for
+    # producer backpressure.
+
+    def stream_next(self, task_id: bytes, owner: str, index: int,
+                    timeout: float | None = None):
+        """Block until item `index` of the stream exists; return its
+        ObjectRef. Raises StopIteration at end-of-stream, the producer's
+        error past the last yielded item, or GetTimeoutError."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        if owner == self.address:
+            return self._stream_next_local(task_id, index, deadline)
+        while True:
+            t = self._remaining(deadline)  # raises GetTimeoutError
+            try:
+                value, frames = self.client.call_frames(
+                    owner, "stream_next", {"task_id": task_id, "index": index},
+                    timeout=min(t, 6.0) if t is not None else 6.0)
+            except PeerUnavailableError as e:
+                if "timed out" in str(e):
+                    continue
+                raise exc.OwnerDiedError(
+                    f"stream owner {owner} unreachable") from e
+            status = value["status"]
+            if status == "pending":
+                continue
+            if status == "end":
+                raise StopIteration
+            if status == "error":
+                raise ser.loads_msg(frames[0])
+            if status == "ready":
+                oid = value["oid"]
+                if value.get("inline"):
+                    # small item: ownership TRANSFERRED with the payload
+                    # (the owner popped its copy) — register it as ours
+                    st = _Owned()
+                    st.inline = bytes(frames[0])
+                    st.size = len(st.inline)
+                    st.event.set()
+                    with self._lock:
+                        self._owned[oid] = st
+                    return ObjectRef(ObjectID(oid), owner=self.address)
+                return ObjectRef(ObjectID(oid), owner=owner)
+            raise exc.ObjectLostError(
+                f"stream item {index} lost ({status}) — streams are "
+                f"single-consumer")
+
+    def _stream_next_local(self, task_id: bytes, index: int, deadline):
+        with self._lock:
+            stream = self._streams.get(task_id)
+        if stream is None:
+            raise StopIteration  # closed or fully consumed earlier
+        ended = False
+        with stream.cond:
+            while True:
+                if index in stream.items:
+                    oid = stream.items[index]
+                    stream.consumed = max(stream.consumed, index + 1)
+                    stream.cond.notify_all()
+                    break
+                if stream.end is not None and index >= stream.end:
+                    ended = True
+                    break
+                if stream.error is not None:
+                    raise stream.error
+                rem = (None if deadline is None
+                       else deadline - time.monotonic())
+                if rem is not None and rem <= 0:
+                    raise exc.GetTimeoutError("stream_next timed out")
+                stream.cond.wait(min(rem, 1.0) if rem is not None else 1.0)
+        if ended:
+            self._stream_pop(task_id, stream)
+            raise StopIteration
+        return ObjectRef(ObjectID(oid), owner=self.address)
+
+    def _stream_pop(self, task_id: bytes, stream: _StreamState):
+        """Exhausted: drop the order book and the (ref-less) sentinel."""
+        with self._lock:
+            self._streams.pop(task_id, None)
+            sent = self._owned.get(stream.sentinel)
+            if sent is not None and self._refcounts.get(stream.sentinel,
+                                                        0) == 0:
+                self._owned.pop(stream.sentinel, None)
+
+    def _h_stream_item(self, msg, frames):
+        task_id, index, oid = msg["task_id"], msg["index"], msg["oid"]
+        loc = msg.get("location")
+        with self._lock:
+            stream = self._streams.get(task_id)
+            if stream is not None:
+                st = self._owned.get(oid)
+                if st is None:
+                    st = _Owned()
+                    self._owned[oid] = st
+                # retry replay HEALS a dead location: the re-executed
+                # producer may live on a different node, and the item oid
+                # is deterministic in (task_id, index)
+                if loc is None:
+                    st.inline = bytes(frames[0])
+                    st.size = len(st.inline)
+                    st.location = None
+                    st.store_name = None
+                else:
+                    st.inline = None
+                    st.location = loc["address"]
+                    st.store_name = loc.get("store_name")
+                    st.size = loc.get("size", 0)
+                st.event.set()
+        orphan = stream is None
+        if stream is not None:
+            with stream.cond:
+                if stream.closed:
+                    # lost the race with _h_stream_close: its free sweep
+                    # ran off `items` before this index landed — undo the
+                    # registration and free the bytes ourselves
+                    orphan = True
+                else:
+                    stream.items[index] = oid
+                    if msg.get("producer"):
+                        stream.producer = msg["producer"]
+                    stream.cond.notify_all()
+        if orphan:
+            with self._lock:
+                st = self._owned.get(oid)
+                if st is not None and self._refcounts.get(oid, 0) == 0 \
+                        and not st.borrowers:
+                    self._owned.pop(oid, None)
+            if loc is not None:
+                try:
+                    self.client.send_oneway(loc["address"], "free_object",
+                                            {"oid": oid})
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _h_stream_end(self, msg, frames):
+        with self._lock:
+            stream = self._streams.get(msg["task_id"])
+        if stream is None:
+            return
+        with stream.cond:
+            if stream.error is None and stream.end is None:
+                stream.end = int(msg["count"])
+            if msg.get("producer"):
+                stream.producer = msg["producer"]
+            stream.cond.notify_all()
+
+    def _h_stream_next(self, msg, frames):
+        """Remote-consumer next (borrower iterating a pickled generator).
+        Long-polls ~4.5s then reports pending, like resolve."""
+        task_id, index = msg["task_id"], msg["index"]
+        with self._lock:
+            stream = self._streams.get(task_id)
+        if stream is None:
+            return {"status": "end"}
+        # the request for index N is the delivery ACK for index N-1:
+        # retire OUR copy of the previous inline item only now, so a
+        # reply lost in transit is recoverable by re-asking the same
+        # index (popping at handout would make a client-side timeout
+        # permanently lose a produced item)
+        if index > 0:
+            with stream.cond:
+                prev = stream.items.get(index - 1)
+            if prev is not None:
+                with self._lock:
+                    st = self._owned.get(prev)
+                    if st is not None and st.inline is not None and \
+                            self._refcounts.get(prev, 0) == 0 and \
+                            not st.borrowers:
+                        self._owned.pop(prev, None)
+        oid = None
+        ended = False
+        err = None
+        with stream.cond:
+            deadline = time.monotonic() + 4.5
+            while True:
+                if index in stream.items:
+                    oid = stream.items[index]
+                    stream.consumed = max(stream.consumed, index + 1)
+                    stream.cond.notify_all()
+                    break
+                if stream.end is not None and index >= stream.end:
+                    ended = True
+                    break
+                if stream.error is not None:
+                    err = stream.error
+                    break
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    return {"status": "pending"}
+                stream.cond.wait(rem)
+        if ended:
+            self._stream_pop(task_id, stream)
+            return {"status": "end"}
+        if err is not None:
+            return {"status": "error"}, [ser.dumps_msg(err)]
+        with self._lock:
+            st = self._owned.get(oid)
+            if st is not None and st.inline is not None:
+                # serve inline payload WITH the ref; the consumer caches
+                # it as its own copy, and our entry retires on the next
+                # index's ack (above) / stream close
+                return ({"status": "ready", "oid": oid, "inline": True},
+                        [st.inline])
+        if st is None:
+            return {"status": "lost"}
+        return {"status": "ready", "oid": oid, "inline": False}
+
+    def _h_stream_state(self, msg, frames):
+        """Producer backpressure poll: consumer progress + liveness."""
+        with self._lock:
+            stream = self._streams.get(msg["task_id"])
+        if stream is None:
+            return {"consumed": 1 << 60, "closed": True}
+        with stream.cond:
+            return {"consumed": stream.consumed, "closed": stream.closed}
+
+    def stream_close(self, task_id: bytes, owner: str):
+        """Consumer dropped the generator early. May run from __del__ at
+        an arbitrary gc point: only QUEUE the oneway (even to ourselves);
+        the submit sweeper flushes it (same rule as borrow_release)."""
+        with self._lock:
+            self._deferred_sends.append(
+                (owner, "stream_close", {"task_id": task_id}))
+
+    def _h_stream_close(self, msg, frames):
+        task_id = msg["task_id"]
+        with self._lock:
+            stream = self._streams.pop(task_id, None)
+        if stream is None:
+            return
+        with stream.cond:
+            stream.closed = True
+            items = list(stream.items.items())
+            consumed = stream.consumed
+            producer = stream.producer
+            stream.cond.notify_all()
+        freed = []
+        with self._lock:
+            for i, oid in items:
+                if self._refcounts.get(oid, 0) > 0:
+                    continue
+                st = self._owned.get(oid)
+                if st is None or st.borrowers:
+                    continue
+                # free unconsumed items outright; consumed INLINE items
+                # were served with their payload (the remote consumer
+                # holds its own copy), so retire those too — consumed
+                # LOCATED items may still be fetched by a live borrower
+                # ref, keep them for the borrow protocol to release
+                if i >= consumed or st.inline is not None:
+                    self._owned.pop(oid, None)
+                    if i >= consumed:
+                        freed.append((oid, st))
+            # the sentinel never has a user-visible ObjectRef: drop it
+            # unconditionally (event may not be set yet if the producer
+            # is still being cancelled — a late task_done just no-ops)
+            if self._refcounts.get(stream.sentinel, 0) == 0:
+                self._owned.pop(stream.sentinel, None)
+        for oid, st in freed:
+            self._release_pin(oid)
+            self._free_remote_bytes(st, oid)
+        if producer:
+            try:
+                self.client.send_oneway(producer, "stream_cancel",
+                                        {"task_id": task_id})
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _stream_fail(self, task_id: bytes, error: BaseException):
+        """Producer died / task exhausted retries: wake the consumer with
+        the error past the last delivered item."""
+        with self._lock:
+            stream = self._streams.get(task_id)
+        if stream is None:
+            return
+        with stream.cond:
+            if stream.end is None and stream.error is None:
+                stream.error = error
+            stream.cond.notify_all()
 
     # ------------------------------------------------------------ tasks
 
@@ -1096,7 +1417,11 @@ class ClusterRuntime:
         return norm
 
     def submit_task(self, fn, args, kwargs, opts: TaskOptions):
-        n = opts.num_returns
+        streaming = opts.num_returns in ("streaming", "dynamic")
+        # a streaming task has ONE sentinel return oid: it completes with
+        # the item count when the generator is exhausted, and carries the
+        # spec so the whole retry pipeline applies to the stream unchanged
+        n = 1 if streaming else opts.num_returns
         oids = [ObjectID.random() for _ in range(n)]
         fn_id = self._export_fn(fn)
         eargs, ekwargs, ref_oids = self._encode_args(args, kwargs)
@@ -1118,11 +1443,15 @@ class ClusterRuntime:
             label_selector=opts.label_selector,
             runtime_env=self._normalized_runtime_env(opts.runtime_env),
             trace=_child_trace(self._ctx.trace),
+            streaming=streaming,
+            backpressure=int(opts.generator_backpressure_num_objects or 0),
         )
         with self._lock:
             for o in oids:
                 self._owned[o.binary()] = _Owned(spec=spec,
                                                 retries_left=opts.max_retries)
+            if streaming:
+                self._streams[spec.task_id] = _StreamState(oids[0].binary())
         self._pin_task_args(spec.task_id, ref_oids)
         # arg locality: prefer the node already holding the largest args
         # (reference: LocalityAwareLeasePolicy, core_worker/lease_policy.h:58)
@@ -1172,6 +1501,10 @@ class ClusterRuntime:
                 self.client.call(target, "schedule_task",
                                  {"spec": dataclass_dict(spec)},
                                  timeout=60, retries=2)
+        if streaming:
+            from ray_tpu.core.api import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id, self.address)
         refs = [ObjectRef(o, owner=self.address) for o in oids]
         if n == 0:
             return []
@@ -1605,7 +1938,9 @@ class ClusterRuntime:
 
     def submit_actor_task(self, actor_id: ActorID, mname: str, args, kwargs,
                           mopts: dict):
-        n = int(mopts.get("num_returns", 1))
+        nr = mopts.get("num_returns", 1)
+        streaming = nr in ("streaming", "dynamic")
+        n = 1 if streaming else int(nr)
         oids = [ObjectID.random() for _ in range(n)]
         eargs, ekwargs, ref_oids = self._encode_args(args, kwargs)
         ab = actor_id.binary()
@@ -1613,6 +1948,8 @@ class ClusterRuntime:
         with self._lock:
             for o in oids:
                 self._owned[o.binary()] = _Owned()
+            if streaming:
+                self._streams[task_id] = _StreamState(oids[0].binary())
         self._pin_task_args(task_id, ref_oids)
         msg = {
             "actor_id": ab,
@@ -1625,7 +1962,19 @@ class ClusterRuntime:
         }
         if mopts.get("concurrency_group"):
             msg["concurrency_group"] = mopts["concurrency_group"]
+        if streaming:
+            msg["streaming"] = True
+            msg["backpressure"] = int(
+                mopts.get("generator_backpressure_num_objects") or 0)
         msg["trace"] = _child_trace(self._ctx.trace)
+        if streaming:
+            # streaming actor calls always ride the pipelined at-most-once
+            # path (a mid-stream duplicate execution would interleave two
+            # producers into one order book)
+            from ray_tpu.core.api import ObjectRefGenerator
+
+            self._submit_actor_pipelined(ab, task_id, msg, oids)
+            return ObjectRefGenerator(task_id, self.address)
         # At-most-once by default (reference: actor tasks are not retried
         # unless max_task_retries>0, python/ray/actor.py): once a push may
         # have been DELIVERED (it timed out rather than failing to send),
@@ -1692,6 +2041,7 @@ class ClusterRuntime:
             addr = self._resolve_actor(ab)
         except exc.RayTpuError as e:
             self._error_oids(obids, e)
+            self._stream_fail(task_id, e)
             self._unpin_task_args(task_id)
             return
         # register BEFORE the push: a fast task_done must find the entry
@@ -1709,8 +2059,10 @@ class ClusterRuntime:
                 self._task_actor.pop(task_id, None)
                 self._actor_addr.pop(ab, None)  # force re-resolve next call
             if not done:
-                self._error_oids(obids, exc.ActorUnavailableError(
-                    "actor call delivery failed (no enqueue ack)"))
+                err = exc.ActorUnavailableError(
+                    "actor call delivery failed (no enqueue ack)")
+                self._error_oids(obids, err)
+                self._stream_fail(task_id, err)
                 self._unpin_task_args(task_id)
 
         with self._lock:

@@ -14,7 +14,13 @@ class TaskOptions:
     num_cpus: float | None = None
     num_tpus: float | None = None
     resources: dict[str, float] = dataclasses.field(default_factory=dict)
-    num_returns: int = 1
+    # int, or "streaming" for generator tasks (each yield becomes one
+    # stream item delivered to the owner as produced — reference:
+    # num_returns="streaming", python/ray/_raylet.pyx generator tasks)
+    num_returns: int | str = 1
+    # streaming only: cap on yielded-but-unconsumed items before the
+    # producer blocks (reference: _generator_backpressure_num_objects)
+    generator_backpressure_num_objects: int | None = None
     max_retries: int = 3
     retry_exceptions: bool | list = False
     name: str | None = None
@@ -90,6 +96,10 @@ def _normalize(d: dict) -> dict:
 def task_options(d: dict) -> TaskOptions:
     _check(d, _TASK_KEYS, "task")
     d = _normalize(d)
+    nr = d.get("num_returns", 1)
+    if isinstance(nr, str) and nr not in ("streaming", "dynamic"):
+        raise ValueError(
+            f'num_returns must be an int or "streaming", got {nr!r}')
     return TaskOptions(**{k: v for k, v in d.items() if k in _TASK_KEYS})
 
 

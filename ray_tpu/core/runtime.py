@@ -70,6 +70,21 @@ class _LocalActor:
     init_done: threading.Event = dataclasses.field(default_factory=threading.Event)
 
 
+class _LocalStream:
+    """Local-mode order book for one streaming-generator task (same
+    semantics as the cluster _StreamState, minus the wire)."""
+
+    __slots__ = ("cond", "oids", "end", "error", "closed", "consumed")
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.oids: list[ObjectID] = []
+        self.end = False
+        self.error: BaseException | None = None
+        self.closed = False
+        self.consumed = 0
+
+
 class _Context(threading.local):
     def __init__(self):
         self.actor_id: ActorID | None = None
@@ -103,6 +118,10 @@ class LocalRuntime:
         self._resources.setdefault("CPU", num_cpus if num_cpus is not None else 8)
         if num_tpus:
             self._resources["TPU"] = num_tpus
+        # RLock: stream_close runs from ObjectRefGenerator.__del__ at
+        # arbitrary gc points (same reasoning as _objects_lock)
+        self._streams: dict[bytes, _LocalStream] = {}
+        self._streams_lock = threading.RLock()
         self._shutdown = False
 
     # ------------------------------------------------------------ objects
@@ -194,9 +213,107 @@ class LocalRuntime:
 
         return tuple(resolve(a) for a in args), {k: resolve(v) for k, v in kwargs.items()}
 
+    # ------------------------------------------------------------ streams
+
+    def _run_stream_local(self, stream: _LocalStream, gen,
+                          backpressure: int):
+        try:
+            for value in gen:
+                with stream.cond:
+                    if stream.closed:
+                        break
+                    oid = ObjectID.random()
+                    self._slot(oid).set_value(value)
+                    stream.oids.append(oid)
+                    stream.cond.notify_all()
+                    while (backpressure and not stream.closed and
+                           len(stream.oids) - stream.consumed >=
+                           backpressure):
+                        stream.cond.wait(0.5)
+        except Exception as e:  # noqa: BLE001
+            with stream.cond:
+                stream.error = exc.TaskError.from_exception(e, "stream")
+                stream.cond.notify_all()
+            return
+        finally:
+            if hasattr(gen, "close"):
+                try:
+                    gen.close()
+                except Exception:  # noqa: BLE001
+                    pass
+        with stream.cond:
+            stream.end = True
+            stream.cond.notify_all()
+
+    def stream_next(self, task_id: bytes, owner: str, index: int,
+                    timeout: float | None = None):
+        with self._streams_lock:
+            stream = self._streams.get(task_id)
+        if stream is None:
+            raise StopIteration
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with stream.cond:
+            while True:
+                if index < len(stream.oids):
+                    stream.consumed = max(stream.consumed, index + 1)
+                    stream.cond.notify_all()
+                    return ObjectRef(stream.oids[index])
+                if stream.error is not None:
+                    raise stream.error
+                if stream.end:
+                    break
+                rem = (None if deadline is None
+                       else deadline - time.monotonic())
+                if rem is not None and rem <= 0:
+                    raise exc.GetTimeoutError("stream_next timed out")
+                stream.cond.wait(min(rem, 1.0) if rem is not None else 1.0)
+        with self._streams_lock:
+            self._streams.pop(task_id, None)
+        raise StopIteration
+
+    def stream_close(self, task_id: bytes, owner: str):
+        with self._streams_lock:
+            stream = self._streams.pop(task_id, None)
+        if stream is None:
+            return
+        with stream.cond:
+            stream.closed = True
+            drop = stream.oids[stream.consumed:]
+            stream.cond.notify_all()
+        with self._objects_lock:
+            for oid in drop:
+                if self._refcounts.get(oid, 0) <= 0:
+                    self._objects.pop(oid, None)
+
     # ------------------------------------------------------------ tasks
 
     def submit_task(self, fn: Callable, args, kwargs, opts: TaskOptions):
+        streaming = opts.num_returns in ("streaming", "dynamic")
+        if streaming:
+            task_id = TaskID.random()
+            stream = _LocalStream()
+            with self._streams_lock:
+                self._streams[task_id.binary()] = stream
+            bp = int(opts.generator_backpressure_num_objects or 0)
+
+            def run_stream():
+                self._ctx.task_id = task_id
+                try:
+                    a, kw = self._resolve_args(args, kwargs)
+                    gen = fn(*a, **kw)
+                except Exception as e:  # noqa: BLE001
+                    with stream.cond:
+                        stream.error = exc.TaskError.from_exception(
+                            e, opts.name or fn.__name__)
+                        stream.cond.notify_all()
+                    return
+                self._run_stream_local(stream, gen, bp)
+
+            threading.Thread(target=run_stream, daemon=True,
+                             name=f"stream-{fn.__name__}").start()
+            from ray_tpu.core.api import ObjectRefGenerator
+
+            return ObjectRefGenerator(task_id.binary(), "local")
         n = opts.num_returns
         oids = [ObjectID.random() for _ in range(n)]
         slots = [self._slot(o) for o in oids]
@@ -306,11 +423,16 @@ class LocalRuntime:
                 continue
             if item is None:
                 break
-            mname, args, kwargs, slots = item
+            mname, args, kwargs, slots, stream_meta = item
             with self._events.span(f"{actor.cls.__name__}.{mname}", "actor_task"):
                 try:
                     a, kw = self._resolve_args(args, kwargs)
                     fn = getattr(actor.instance, mname)
+                    if stream_meta is not None:
+                        gen = fn(*a, **kw)
+                        self._run_stream_local(stream_meta["stream"], gen,
+                                               stream_meta["bp"])
+                        continue
                     result = fn(*a, **kw)
                     if len(slots) == 1:
                         slots[0].set_value(result)
@@ -319,6 +441,12 @@ class LocalRuntime:
                             s.set_value(v)
                 except Exception as e:  # noqa: BLE001
                     err = exc.TaskError.from_exception(e, f"{actor.cls.__name__}.{mname}")
+                    if stream_meta is not None:
+                        st = stream_meta["stream"]
+                        with st.cond:
+                            st.error = err
+                            st.cond.notify_all()
+                        continue
                     for s in slots:
                         s.set_error(err)
         # Error-drain anything still queued so callers never hang on a
@@ -332,24 +460,54 @@ class LocalRuntime:
             while True:
                 item = actor.inbox.get_nowait()
                 if item:
-                    for s in item[3]:
-                        s.set_error(exc.ActorDiedError(cause))
+                    self._fail_actor_item(item, cause)
         except _queue.Empty:
             pass
+
+    @staticmethod
+    def _fail_actor_item(item, cause: str):
+        err = exc.ActorDiedError(cause)
+        if len(item) > 4 and item[4] is not None:
+            st = item[4]["stream"]
+            with st.cond:
+                st.error = err
+                st.cond.notify_all()
+            return
+        for s in item[3]:
+            s.set_error(err)
 
     def submit_actor_task(self, actor_id: ActorID, mname: str, args, kwargs, mopts: dict):
         with self._actors_lock:
             actor = self._actors.get(actor_id)
         if actor is None:
             raise exc.ActorDiedError(f"no such actor {actor_id}")
-        n = int(mopts.get("num_returns", 1))
+        nr = mopts.get("num_returns", 1)
+        if nr in ("streaming", "dynamic"):
+            from ray_tpu.core.api import ObjectRefGenerator
+
+            task_id = TaskID.random()
+            stream = _LocalStream()
+            with self._streams_lock:
+                self._streams[task_id.binary()] = stream
+            meta = {"stream": stream, "bp": int(
+                mopts.get("generator_backpressure_num_objects") or 0)}
+            item = (mname, args, kwargs, [], meta)
+            if actor.dead:
+                self._fail_actor_item(item, actor.death_cause
+                                      or "actor is dead")
+            else:
+                actor.inbox.put(item)
+                if actor.dead:
+                    self._drain_actor_inbox(actor)
+            return ObjectRefGenerator(task_id.binary(), "local")
+        n = int(nr)
         oids = [ObjectID.random() for _ in range(n)]
         slots = [self._slot(o) for o in oids]
         if actor.dead:
             for s in slots:
                 s.set_error(exc.ActorDiedError(actor.death_cause or "actor is dead"))
         else:
-            actor.inbox.put((mname, args, kwargs, slots))
+            actor.inbox.put((mname, args, kwargs, slots, None))
             if actor.dead:
                 # lost the race with actor death: loop threads may have
                 # already drained and exited — drain again ourselves.

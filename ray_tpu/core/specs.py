@@ -52,6 +52,16 @@ class TaskSpec:
     # (reference: opentelemetry span propagation through task submission,
     # python/ray/util/tracing/tracing_helper.py:34)
     trace: dict | None = None
+    # streaming generator task (num_returns="streaming"): the worker
+    # ships each yielded value as a stream_item to the owner as it is
+    # produced; return_oids holds ONE sentinel oid that completes (with
+    # the item count) when the generator is exhausted — so the whole
+    # retry/failure machinery applies unchanged (reference: ObjectRefStream
+    # bookkeeping, src/ray/core_worker/task_manager.h:104).
+    streaming: bool = False
+    # max yielded-but-unconsumed items before the producer blocks
+    # (reference: _generator_backpressure_num_objects); 0 = unbounded
+    backpressure: int = 0
 
 
 @dataclasses.dataclass

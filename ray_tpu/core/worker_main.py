@@ -63,6 +63,12 @@ class WorkerRuntime(ClusterRuntime):
         # the lease this worker currently serves (set by the nodelet at
         # grant time, cleared at return/expiry); guards direct pushes
         self._current_lease: bytes | None = None
+        # active streaming-generator producers: task_id -> cancel event
+        # (reference: generator execution + backpressure in _raylet.pyx)
+        self._active_streams: dict[bytes, threading.Event] = {}
+        self._active_streams_lock = threading.Lock()
+        self.server.register("stream_cancel", self._h_stream_cancel,
+                             oneway=True)
         self.server.register("execute_task", self._h_execute_task, oneway=True)
         self.server.register("execute_leased", self._h_execute_leased)
         self.server.register("set_lease", self._h_set_lease)
@@ -134,6 +140,99 @@ class WorkerRuntime(ClusterRuntime):
             })
         except Exception:
             pass
+
+    # ------------------------------------------------------------ streaming
+
+    def _h_stream_cancel(self, msg, frames):
+        """Owner dropped the generator handle: stop producing."""
+        with self._active_streams_lock:
+            ev = self._active_streams.get(msg["task_id"])
+        if ev is not None:
+            ev.set()
+
+    @staticmethod
+    def stream_item_oid(task_id: bytes, index: int) -> bytes:
+        """Deterministic item oid: a retried producer regenerates the SAME
+        ids, so replayed stream_items dedup/heal at the owner instead of
+        forking the stream (reference: dynamic return ids are deterministic
+        in (task_id, index), src/ray/common/id.h ObjectID::FromIndex)."""
+        import hashlib
+
+        return hashlib.sha1(
+            b"stream" + task_id + index.to_bytes(8, "little")).digest()[:16]
+
+    def _run_stream(self, owner: str, task_id: bytes, gen,
+                    backpressure: int) -> int:
+        """Drain a user generator, shipping each yielded value to the
+        owner as a stream_item (inline or via the local shm store). Sends
+        the terminating stream_end; returns the item count (the sentinel
+        result). Honors owner backpressure and cancel."""
+        cancel = threading.Event()
+        with self._active_streams_lock:
+            self._active_streams[task_id] = cancel
+        produced = 0
+        acked = 0
+        try:
+            for value in gen:
+                if cancel.is_set():
+                    break
+                oid = self.stream_item_oid(task_id, produced)
+                head_payload, views, total = ser.serialize(value)
+                loc = None
+                if total <= INLINE_THRESHOLD:
+                    buf = bytearray(total)
+                    ser.write_into(memoryview(buf), head_payload, views)
+                    frames = [bytes(buf)]
+                else:
+                    try:
+                        mv = self.store.create(oid, total)
+                        ser.write_into(mv, head_payload, views)
+                        del mv
+                        self.store.seal(oid)
+                        frames = [b""]
+                        loc = {"address": self.nodelet_address,
+                               "store_name": self.store.name, "size": total}
+                    except KeyError:  # already present (retry replay)
+                        frames = [b""]
+                        loc = {"address": self.nodelet_address,
+                               "store_name": self.store.name, "size": total}
+                    except Exception:  # store full: ship inline
+                        buf = bytearray(total)
+                        ser.write_into(memoryview(buf), head_payload, views)
+                        frames = [bytes(buf)]
+                self.client.send_oneway(owner, "stream_item", {
+                    "task_id": task_id, "index": produced, "oid": oid,
+                    "location": loc, "producer": self.address,
+                }, frames=frames)
+                produced += 1
+                if backpressure and produced - acked >= backpressure:
+                    while not cancel.is_set():
+                        try:
+                            r = self.client.call(owner, "stream_state",
+                                                 {"task_id": task_id},
+                                                 timeout=10)
+                        except Exception:  # noqa: BLE001
+                            cancel.set()  # owner gone: stop producing
+                            break
+                        if r.get("closed"):
+                            cancel.set()
+                            break
+                        acked = max(acked, int(r.get("consumed", 0)))
+                        if produced - acked < backpressure:
+                            break
+                        time.sleep(0.02)
+        finally:
+            if hasattr(gen, "close"):
+                try:
+                    gen.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            with self._active_streams_lock:
+                self._active_streams.pop(task_id, None)
+        self.client.send_oneway(owner, "stream_end",
+                                {"task_id": task_id, "count": produced,
+                                 "producer": self.address})
+        return produced
 
     # ------------------------------------------------------------ normal tasks
 
@@ -229,6 +328,16 @@ class WorkerRuntime(ClusterRuntime):
         try:
             fn = self._fetch_fn(spec.fn_id)
             a, kw = self._decode_args(spec.args, spec.kwargs)
+            if spec.streaming:
+                with self._events.span(spec.name, "task", trace=spec.trace):
+                    gen = fn(*a, **kw)
+                    count = self._run_stream(spec.owner, spec.task_id, gen,
+                                             spec.backpressure)
+                self._ship_results(spec.owner, spec.task_id,
+                                   spec.return_oids, [count])
+                self._report_task_event(spec.task_id, spec.name, "FINISHED",
+                                        t_start, "NORMAL_TASK")
+                return
             with self._events.span(spec.name, "task", trace=spec.trace):
                 result = fn(*a, **kw)
             n = len(spec.return_oids)
@@ -368,6 +477,31 @@ class WorkerRuntime(ClusterRuntime):
             try:
                 a, kw = self._decode_args(msg["args"], msg["kwargs"])
                 fn = getattr(self._actor_instance, mname)
+                if msg.get("streaming"):
+                    if inspect.iscoroutinefunction(fn) or \
+                            inspect.isasyncgenfunction(fn):
+                        raise TypeError(
+                            f"{mname}: async streaming actor methods are "
+                            f"not supported; use a sync generator")
+                    # the stream occupies this method slot until drained
+                    # (serial actors stay one-method-at-a-time throughout)
+                    with self._events.span(label, "actor_task",
+                                           trace=msg.get("trace")):
+                        if self._serial_actor:
+                            with self._instance_lock:
+                                gen = fn(*a, **kw)
+                                count = self._run_stream(
+                                    owner, task_id, gen,
+                                    msg.get("backpressure", 0))
+                        else:
+                            gen = fn(*a, **kw)
+                            count = self._run_stream(
+                                owner, task_id, gen,
+                                msg.get("backpressure", 0))
+                    self._ship_results(owner, task_id, oids, [count])
+                    self._report_task_event(task_id, label, "FINISHED",
+                                            t_start, "ACTOR_TASK")
+                    continue
                 if inspect.iscoroutinefunction(fn):
                     # async method: schedule on the event loop and move on
                     # — completions land out of submission order while

@@ -42,11 +42,83 @@ def _fuse(ops: list[LogicalOperator]) -> Callable[[list], list]:
     return LogicalPlan(list(ops)).compile()
 
 
+def _read_stream_impl(thunk):
+    yield from thunk()
+
+
+_READ_STREAM = None
+
+
+def _read_stream_remote():
+    """Module-level streaming read task (ONE stable function object, so
+    the runtime's identity-keyed export cache ships it once per
+    process, not once per iteration)."""
+    global _READ_STREAM
+    if _READ_STREAM is None:
+        import ray_tpu
+
+        _READ_STREAM = ray_tpu.remote(num_cpus=1)(_read_stream_impl)
+    return _READ_STREAM
+
+
+class _StreamingInput:
+    """Pollable block-ref source over streaming read tasks, drained in
+    task order (producers all run concurrently; items buffer at the
+    owner). The StreamingExecutor polls so already-transformed blocks
+    keep flowing while the next read block is still being produced."""
+
+    def __init__(self, gens):
+        self._gens = gens
+        self._i = 0
+
+    def poll(self, timeout: float):
+        from ray_tpu.core import exceptions as _exc
+
+        while self._i < len(self._gens):
+            try:
+                return ("item", self._gens[self._i]._next_sync(timeout))
+            except StopIteration:
+                self._i += 1
+                continue
+            except _exc.GetTimeoutError:
+                return ("pending", None)
+        return ("end", None)
+
+    def __iter__(self):
+        while True:
+            kind, ref = self.poll(30.0)
+            if kind == "end":
+                return
+            if kind == "item":
+                yield ref
+
+
 class Dataset:
     def __init__(self, block_refs: list,
-                 ops: list[LogicalOperator] | None = None):
+                 ops: list[LogicalOperator] | None = None,
+                 stream_thunks: list | None = None):
         self._block_refs = block_refs  # ObjectRefs of input blocks
         self._ops = ops or []
+        # streaming read source: generator thunks run as
+        # num_returns="streaming" tasks; block refs materialize DURING
+        # iteration (read_datasource(streaming=True))
+        self._stream_thunks = stream_thunks
+
+    def _input_blocks(self):
+        """Input block refs: the eager list, or a pollable source pulling
+        from streaming read tasks as the producers yield blocks."""
+        if self._stream_thunks is None:
+            return list(self._block_refs)
+        gens = [_read_stream_remote().options(
+            num_returns="streaming").remote(t)
+            for t in self._stream_thunks]
+        return _StreamingInput(gens)
+
+    def _require_eager(self, what: str):
+        if self._stream_thunks is not None:
+            raise ValueError(
+                f"{what} needs a known block list; call materialize() on "
+                f"this streaming dataset first")
 
     # ------------------------------------------------------------ create
 
@@ -73,7 +145,8 @@ class Dataset:
     # ------------------------------------------------------------ transforms
 
     def _with(self, op: LogicalOperator) -> "Dataset":
-        return Dataset(self._block_refs, self._ops + [op])
+        return Dataset(self._block_refs, self._ops + [op],
+                       stream_thunks=self._stream_thunks)
 
     def map(self, fn: Callable) -> "Dataset":
         return self._with(MapRows(fn))
@@ -106,7 +179,8 @@ class Dataset:
                 last = i
         if last is None:
             return None
-        prefix = Dataset(self._block_refs, self._ops[:last + 1])
+        prefix = Dataset(self._block_refs, self._ops[:last + 1],
+                         stream_thunks=self._stream_thunks)
         rows = prefix.take_all()  # iterator cap enforces the global n
         out = Dataset.from_items(rows, max(1, len(self._block_refs)))
         return Dataset(out._block_refs, self._ops[last + 1:])
@@ -131,7 +205,8 @@ class Dataset:
             return list(out)
 
         if compute == "actors":
-            ds = Dataset(self._block_refs, self._ops)
+            ds = Dataset(self._block_refs, self._ops,
+                         stream_thunks=self._stream_thunks)
             ds._actor_stage = (apply, num_actors)  # type: ignore[attr-defined]
             return ds
         return self._with(_MapBatchesOp(apply))
@@ -150,7 +225,8 @@ class Dataset:
         containing a Limit must be materialized first — the exchange's
         map stage is per-block, so a per-block limit would leak n rows
         PER BLOCK into the shuffle instead of n total."""
-        if any(isinstance(o, Limit) for o in self._ops):
+        if any(isinstance(o, Limit) for o in self._ops) or \
+                self._stream_thunks is not None:
             rows = self.take_all()
             ds = Dataset.from_items(rows, max(1, len(self._block_refs)))
             return ds._block_refs, []
@@ -198,6 +274,7 @@ class Dataset:
 
     def shard(self, num_shards: int, index: int) -> "Dataset":
         """Deterministic block-wise shard (per-host Train ingestion)."""
+        self._require_eager("shard()")
         refs = [r for i, r in enumerate(self._block_refs)
                 if i % num_shards == index]
         return Dataset(refs or [], list(self._ops))
@@ -218,7 +295,7 @@ class Dataset:
 
         actor_stage = getattr(self, "_actor_stage", None)
         if not self._ops and actor_stage is None:
-            yield from self._block_refs
+            yield from self._input_blocks()
             return
         if actor_stage is None:
             split = self._split_at_mid_limit()
@@ -236,7 +313,7 @@ class Dataset:
             executor = StreamingExecutor(default_policies(
                 max_in_flight=max_in_flight, memory_budget=memory_budget))
             self._last_executor = executor  # observability / tests
-            yield from executor.run(list(self._block_refs),
+            yield from executor.run(self._input_blocks(),
                                     lambda ref: _apply_block.remote(ref))
             return
 
@@ -261,7 +338,7 @@ class Dataset:
             def submit(ref):
                 return actors[next(counter) % num_actors].apply.remote(ref)
 
-            yield from executor.run(list(self._block_refs), submit)
+            yield from executor.run(self._input_blocks(), submit)
         finally:
             for a in actors:
                 try:
@@ -392,7 +469,8 @@ class Dataset:
     def count(self) -> int:
         import ray_tpu
 
-        if not self._ops and getattr(self, "_actor_stage", None) is None:
+        if not self._ops and getattr(self, "_actor_stage", None) is None \
+                and self._stream_thunks is None:
             return sum(len(b) for b in
                        ray_tpu.get(list(self._block_refs), timeout=600))
         return sum(1 for _ in self.iter_rows())
@@ -571,13 +649,25 @@ def from_numpy(arr: np.ndarray, parallelism: int = _DEFAULT_PARALLELISM
 
 
 def read_datasource(datasource, *,
-                    parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
+                    parallelism: int = _DEFAULT_PARALLELISM,
+                    streaming: bool = False) -> Dataset:
     """Lazy Dataset over any Datasource (reference:
     ray.data.read_datasource; data/datasource/datasource.py contract).
     Each ReadTask materializes its block INSIDE a remote task — the
-    driver only ships the thunks."""
+    driver only ships the thunks.
+
+    With streaming=True, the read runs as num_returns="streaming" tasks
+    over `get_block_streams`: each producer yields blocks incrementally
+    (e.g. one per file in a group) and downstream consumes block 0 while
+    block k is still being read (reference: streaming read tasks under
+    ray.data's streaming execution)."""
     import ray_tpu
 
+    if streaming:
+        thunks = datasource.get_block_streams(parallelism)
+        if not thunks:
+            raise ValueError(f"{datasource.name} produced no block streams")
+        return Dataset([], stream_thunks=thunks)
     tasks = datasource.get_read_tasks(parallelism)
     if not tasks:
         raise ValueError(f"{datasource.name} produced no read tasks")
@@ -585,38 +675,44 @@ def read_datasource(datasource, *,
     return Dataset(refs, [_ReadOp(lambda block: block[0]())])
 
 
-def _read_files(source_cls, paths, parallelism, *args):
+def _read_files(source_cls, paths, parallelism, *args, streaming=False):
     """File read_* share one recipe: default parallelism is ONE task
     per file (the natural split unit — a 1000-file directory must not
     collapse to 8 serial readers); an explicit value groups files."""
     ds = source_cls(paths, *args)
     return read_datasource(
         ds, parallelism=parallelism if parallelism is not None
-        else max(1, len(ds.paths)))
+        else max(1, len(ds.paths)), streaming=streaming)
 
 
-def read_text(paths, *, parallelism: int | None = None) -> Dataset:
+def read_text(paths, *, parallelism: int | None = None,
+              streaming: bool = False) -> Dataset:
     """One row per line (reference: ray.data.read_text). The line
     splitting runs in the native mmap scanner (data/lineio.py ->
     _native/lineio.cc) inside the read task."""
     from ray_tpu.data.datasource import TextDatasource
 
-    return _read_files(TextDatasource, paths, parallelism)
+    return _read_files(TextDatasource, paths, parallelism,
+                       streaming=streaming)
 
 
-def read_csv(paths, *, parallelism: int | None = None) -> Dataset:
+def read_csv(paths, *, parallelism: int | None = None,
+             streaming: bool = False) -> Dataset:
     """Dict rows from CSV with a header (reference: ray.data.read_csv;
     stdlib csv instead of Arrow)."""
     from ray_tpu.data.datasource import CSVDatasource
 
-    return _read_files(CSVDatasource, paths, parallelism)
+    return _read_files(CSVDatasource, paths, parallelism,
+                       streaming=streaming)
 
 
-def read_json(paths, *, parallelism: int | None = None) -> Dataset:
+def read_json(paths, *, parallelism: int | None = None,
+              streaming: bool = False) -> Dataset:
     """JSONL rows (reference: ray.data.read_json)."""
     from ray_tpu.data.datasource import JSONLDatasource
 
-    return _read_files(JSONLDatasource, paths, parallelism)
+    return _read_files(JSONLDatasource, paths, parallelism,
+                       streaming=streaming)
 
 
 def read_parquet(paths, columns: list[str] | None = None, *,

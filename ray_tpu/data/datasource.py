@@ -36,6 +36,23 @@ class Datasource:
     def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
         raise NotImplementedError
 
+    def get_block_streams(self, parallelism: int) -> list[Callable]:
+        """Streaming form: a list of thunks, each a GENERATOR yielding
+        blocks incrementally. Runs under num_returns="streaming" read
+        tasks so downstream consumes block 0 while the task is still
+        producing block k (reference: streaming read tasks feeding the
+        StreamingExecutor). Default adapts get_read_tasks: one yield per
+        task."""
+        tasks = self.get_read_tasks(parallelism)
+
+        def make(t):
+            def gen():
+                yield t()
+
+            return gen
+
+        return [make(t) for t in tasks]
+
     def estimate_inmemory_data_size(self) -> int | None:
         return None
 
@@ -98,13 +115,16 @@ class FileDatasource(Datasource):
         except OSError:
             return None
 
-    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
-        # one task per file (files are the natural split unit); the
-        # `parallelism` hint can only coarsen by grouping
+    def _groups(self, parallelism: int) -> list[list[str]]:
         groups: list[list[str]] = [[] for _ in
                                    range(min(parallelism, len(self.paths)))]
         for i, p in enumerate(self.paths):
             groups[i % len(groups)].append(p)
+        return [g for g in groups if g]
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        # one task per file (files are the natural split unit); the
+        # `parallelism` hint can only coarsen by grouping
         read = self.read_file
 
         def make(group):
@@ -121,7 +141,22 @@ class FileDatasource(Datasource):
                 pass
             return ReadTask(rd, input_files=group, size_bytes=size)
 
-        return [make(g) for g in groups if g]
+        return [make(g) for g in self._groups(parallelism)]
+
+    def get_block_streams(self, parallelism: int) -> list[Callable]:
+        """One generator per file group, ONE BLOCK PER FILE: with grouped
+        files the first file's rows are consumable while the rest of the
+        group is still being read."""
+        read = self.read_file
+
+        def make(group):
+            def gen():
+                for p in group:
+                    yield read(p)
+
+            return gen
+
+        return [make(g) for g in self._groups(parallelism)]
 
 
 class TextDatasource(FileDatasource):

@@ -98,7 +98,14 @@ class StreamingExecutor:
         self.policies = policies
         self.stats = ExecutionStats()
 
-    def run(self, input_refs: list, submit) -> Iterator:
+    def run(self, input_refs, submit) -> Iterator:
+        """`input_refs` may be a list, a lazy iterator, or an object
+        with `poll(timeout) -> ("item", ref) | ("pending", None) |
+        ("end", None)` (streaming read sources produce block refs
+        incrementally via ObjectRefGenerator — reference: streaming read
+        tasks feed the executor as blocks appear, not after the read
+        completes). Polling keeps completed window results flowing to
+        the consumer while the next input block is still being read."""
         import time as _t
 
         import ray_tpu
@@ -106,22 +113,39 @@ class StreamingExecutor:
         policies = self.policies or default_policies()
         stats = self.stats
         window: list = []  # submitted, not yet yielded (input order)
-        i = 0
-        n = len(input_refs)
-        while i < n or window:
+        poll = getattr(input_refs, "poll", None)
+        it = iter(input_refs) if poll is None else None
+        exhausted = False
+        while not exhausted or window:
             # account completed-but-unconsumed bytes
             stats.buffered_bytes = sum(_ref_size(r) for r in window)
             stats.peak_buffered_bytes = max(stats.peak_buffered_bytes,
                                             stats.buffered_bytes)
             done = [r for r in window if _ref_size(r) > 0]
             stats.in_flight = len(window) - len(done)
-            if i < n:
+            if not exhausted:
                 if all(p.can_add_input(stats) for p in policies):
-                    window.append(submit(input_refs[i]))
-                    stats.submitted += 1
-                    i += 1
-                    continue
-                stats.backpressure_waits += 1  # admission deferred
+                    if poll is not None:
+                        kind, ref = poll(0.25)
+                        if kind == "item":
+                            window.append(submit(ref))
+                            stats.submitted += 1
+                            continue
+                        if kind == "end":
+                            exhausted = True
+                            continue
+                        # pending: fall through and drain the window
+                    else:
+                        try:
+                            nxt = next(it)
+                        except StopIteration:
+                            exhausted = True
+                        else:
+                            window.append(submit(nxt))
+                            stats.submitted += 1
+                        continue
+                else:
+                    stats.backpressure_waits += 1  # admission deferred
             if window:
                 head = window[0]
                 ready, _ = ray_tpu.wait([head], num_returns=1, timeout=0.5)
