@@ -19,40 +19,78 @@ import time
 BASELINE_TOKENS_PER_SEC_PER_CHIP = 30_000.0
 
 _PPO_SNIPPET = """
-import jax, json, statistics
+import jax, json, statistics, time
 jax.config.update("jax_platforms", "cpu")
 from ray_tpu.rllib import PPOConfig
 algo = (PPOConfig().environment("CartPole-v1")
         .env_runners(num_env_runners=0, num_envs_per_env_runner=16,
                      rollout_fragment_length=128)
         .training(num_sgd_iter=6, minibatch_size=256)).build()
-algo.train(); algo.train()  # compile + cache warmup
-rates = [algo.train()["env_steps_per_sec"] for _ in range(7)]
+algo.train(); algo.train(); algo.train()  # compile + cache warmup
+# one sample = 4 iterations (~8k env steps): single-iteration samples
+# are ~70ms and swing +-15% from scheduler noise alone
+rates = []
+for _ in range(7):
+    t0 = time.perf_counter()
+    steps = sum(algo.train()["num_env_steps_sampled"] for _ in range(4))
+    rates.append(steps / (time.perf_counter() - t0))
 print(json.dumps({"median": statistics.median(rates),
                   "stdev": statistics.pstdev(rates),
                   "max": max(rates)}))
 """
 
 
+def _wait_for_idle(max_wait_s: float = 240.0, load_thresh: float = 0.7):
+    """Idle-gate (VERDICT r4 weak item 1: the driver-captured PPO number
+    regressed 16% vs an idle box — this bench is contention-sensitive on
+    a 1-core VM, so wait for the load average to settle before
+    measuring)."""
+    import os
+    import time as _t
+
+    t0 = _t.monotonic()
+    while _t.monotonic() - t0 < max_wait_s:
+        try:
+            load1 = os.getloadavg()[0]
+        except OSError:
+            return 0.0
+        if load1 < load_thresh:
+            return _t.monotonic() - t0
+        _t.sleep(5.0)
+    return _t.monotonic() - t0
+
+
 def _ppo_bench_subprocess() -> dict:
-    """Median-of-7 with a variance field (VERDICT r3 item 3: max-of-4
-    was contention-sensitive and regressed 24% between rounds for
-    non-code reasons)."""
+    """Median-of-7 (each sample 4 iterations) with idle-gating and
+    retry-on-variance: re-measure up to 3 times if stdev exceeds 8% of
+    the median, report the attempt with the lowest relative stdev."""
     import json as _json
     import os
     import subprocess
     import sys
 
-    try:
-        env = dict(os.environ, JAX_PLATFORMS="cpu")
-        out = subprocess.run(
-            [sys.executable, "-c", _PPO_SNIPPET], capture_output=True,
-            text=True, timeout=600, env=env,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
-        line = out.stdout.strip().splitlines()[-1]
-        return _json.loads(line)
-    except Exception:
-        return {"median": 0.0, "stdev": 0.0, "max": 0.0}
+    best = {"median": 0.0, "stdev": 0.0, "max": 0.0, "rel": 1e9}
+    for attempt in range(3):
+        waited = _wait_for_idle()
+        try:
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            out = subprocess.run(
+                [sys.executable, "-c", _PPO_SNIPPET], capture_output=True,
+                text=True, timeout=600, env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            line = out.stdout.strip().splitlines()[-1]
+            r = _json.loads(line)
+        except Exception:
+            continue
+        rel = r["stdev"] / r["median"] if r.get("median") else 1e9
+        r["rel"] = rel
+        r["idle_wait_s"] = round(waited, 1)
+        if rel < best["rel"]:
+            best = r
+        if rel <= 0.08:
+            break
+    best.pop("rel", None)
+    return best
 
 
 

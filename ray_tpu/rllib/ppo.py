@@ -42,6 +42,13 @@ class PPOConfig:
     seed: int = 0
     num_learners: int = 0  # >1: learner mesh of that many devices
     learner_mesh: Any = None  # or pass an explicit jax Mesh
+    # Overlap sampling with the jitted update (reference: the async
+    # learner thread, rllib/execution/multi_gpu_learner_thread.py:21,141
+    # — sampling continues while the learner consumes the previous
+    # batch). Queue depth 1: each batch is exactly one update stale,
+    # which PPO's clipped importance ratio absorbs. Pays off when the
+    # learner runs on an accelerator while envs step on host CPU.
+    pipeline_sampling: bool = False
 
     def environment(self, env: str) -> "PPOConfig":
         self.env = env
@@ -170,23 +177,22 @@ class PPO(Checkpointable):
         self.metrics = MetricsLogger()
         self._iteration = 0
         self._env_steps_total = 0
+        # pipeline_sampling state: the fragment prefetched during the
+        # previous iteration's update, and a one-thread executor for the
+        # in-flight jitted update
+        self._prefetched = None
+        self._learn_executor = None
 
-    def train(self) -> dict:
-        """One training iteration (reference: PPO.training_step,
-        ppo.py:389 — sample, learn, sync)."""
-        t0 = time.perf_counter()
-        samples = self.env_runner_group.sample()
-        t_sample = time.perf_counter() - t0
-
-        # concatenate fragments; GAE per fragment (each has its own
-        # bootstrap values), then flatten (T, N) -> (T*N,)
+    def _build_batch(self, samples):
+        """Fragments → one flat train batch: GAE per fragment (each has
+        its own bootstrap values), flatten (T, N) -> (T*N,), drop
+        autoreset steps (their action was ignored by the env — next-step
+        autoreset — so they are not real experience)."""
         obs, acts, logp, adv, targets = [], [], [], [], []
         ep_returns, n_eps, env_steps = [], 0, 0
         for s in samples:
             s = self._learner_connector(s)
             a, tg = s["advantages"], s["value_targets"]
-            # drop autoreset steps: their action was ignored by the env
-            # (next-step autoreset), so they are not real experience
             valid = ~s["reset_mask"].reshape(-1)
             obs.append(s["obs"].reshape(-1, *s["obs"].shape[2:])[valid])
             acts.append(s["actions"].reshape(-1)[valid])
@@ -204,11 +210,10 @@ class PPO(Checkpointable):
             "advantages": np.concatenate(adv),
             "value_targets": np.concatenate(targets),
         }
-        t1 = time.perf_counter()
-        learner_metrics = self.learner.update(train_batch)
-        t_learn = time.perf_counter() - t1
-        self.env_runner_group.sync_weights(self.learner.get_weights())
+        return train_batch, ep_returns, n_eps, env_steps
 
+    def _finish_iteration(self, t0, t_sample, t_learn, ep_returns, n_eps,
+                          env_steps, learner_metrics) -> dict:
         self._iteration += 1
         self._env_steps_total += env_steps
         dt = time.perf_counter() - t0
@@ -231,8 +236,57 @@ class PPO(Checkpointable):
             **{f"learner/{k}": v for k, v in learner_metrics.items()},
         }
 
+    def train(self) -> dict:
+        """One training iteration (reference: PPO.training_step,
+        ppo.py:389 — sample, learn, sync)."""
+        if self.config.pipeline_sampling:
+            return self._train_pipelined()
+        t0 = time.perf_counter()
+        samples = self.env_runner_group.sample()
+        t_sample = time.perf_counter() - t0
+        train_batch, ep_returns, n_eps, env_steps = \
+            self._build_batch(samples)
+        t1 = time.perf_counter()
+        learner_metrics = self.learner.update(train_batch)
+        t_learn = time.perf_counter() - t1
+        self.env_runner_group.sync_weights(self.learner.get_weights())
+        return self._finish_iteration(t0, t_sample, t_learn, ep_returns,
+                                      n_eps, env_steps, learner_metrics)
+
+    def _train_pipelined(self) -> dict:
+        """Async-learner iteration (reference:
+        multi_gpu_learner_thread.py:141 LoaderThread/step overlap): the
+        jitted update on fragment k runs while fragment k+1 is sampled.
+        The runners hold the pre-update weights during the overlap (sync
+        happens after both finish), so each batch is exactly one update
+        stale — logp_old matches the sampling policy, and the clipped
+        ratio absorbs the staleness."""
+        import concurrent.futures as cf
+
+        if self._learn_executor is None:
+            self._learn_executor = cf.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ppo-learn")
+        t0 = time.perf_counter()
+        if self._prefetched is None:
+            self._prefetched = self.env_runner_group.sample()
+        train_batch, ep_returns, n_eps, env_steps = \
+            self._build_batch(self._prefetched)
+        t1 = time.perf_counter()
+        fut = self._learn_executor.submit(self.learner.update, train_batch)
+        # overlap: sample the NEXT fragment while the update executes
+        self._prefetched = self.env_runner_group.sample()
+        t_sample = time.perf_counter() - t1
+        learner_metrics = fut.result()
+        t_learn = time.perf_counter() - t1
+        self.env_runner_group.sync_weights(self.learner.get_weights())
+        return self._finish_iteration(t0, t_sample, t_learn, ep_returns,
+                                      n_eps, env_steps, learner_metrics)
+
     def get_weights(self):
         return self.learner.get_weights()
 
     def stop(self):
+        if self._learn_executor is not None:
+            self._learn_executor.shutdown(wait=False)
+            self._learn_executor = None
         self.env_runner_group.shutdown()
