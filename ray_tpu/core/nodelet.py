@@ -346,10 +346,12 @@ class Nodelet:
         wid = WorkerID.random().binary()
         env = dict(os.environ)
         cwd = None
+        py_exe = None
         ehash = rtenv.env_hash(runtime_env)
         if runtime_env:
-            extra, cwd = rtenv.materialize(runtime_env, self.session_dir,
-                                           self.client, self.head_address)
+            extra, cwd, py_exe = rtenv.materialize(
+                runtime_env, self.session_dir, self.client,
+                self.head_address)
             env.update(extra)
         if cwd is not None:
             # the worker normally imports ray_tpu via the launch cwd; a
@@ -386,7 +388,7 @@ class Nodelet:
             env["JAX_PLATFORMS"] = "cpu"
         log = open(os.path.join(self.log_dir, f"worker-{wid.hex()[:12]}.log"), "ab")
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            [py_exe or sys.executable, "-m", "ray_tpu.core.worker_main"],
             env=env, stdout=log, stderr=subprocess.STDOUT,
             start_new_session=True, cwd=cwd,
         )

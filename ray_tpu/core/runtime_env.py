@@ -113,6 +113,10 @@ class RuntimeEnvContext:
     env: dict[str, str] = field(default_factory=dict)
     py_paths: list[str] = field(default_factory=list)  # PYTHONPATH prepends
     cwd: str | None = None
+    # interpreter override: set by the pip/uv plugins so the worker runs
+    # INSIDE the materialized virtualenv (reference: the pip plugin's
+    # modified python context, runtime_env/pip.py)
+    py_exe: str | None = None
 
 
 # ---------------------------------------------------------------- plugins
@@ -211,23 +215,206 @@ class PyModulesPlugin(RuntimeEnvPlugin):
             ctx.py_paths.append(dest)
 
 
+class PipPlugin(RuntimeEnvPlugin):
+    """Per-env virtualenvs with pip-installed packages (reference:
+    runtime_env/pip.py — a venv per distinct package set, cached and
+    shared across workers; _private/runtime_env/uv.py is the same
+    lifecycle through uv).
+
+    Offline-first: this deployment has zero egress, so installs resolve
+    from a LOCAL wheel source — `{"packages": [...], "find_links": dir}`
+    (the dir's wheels are shipped through the head KV, content-
+    addressed, so remote nodes materialize without a shared FS). An
+    `index_url` passthrough exists for deployments with a reachable
+    index. Envs are content-addressed by (packages, python version) in
+    a node-wide cache, built once under a file lock, reused by every
+    worker/session; the worker process runs ON the venv interpreter
+    (--system-site-packages keeps jax/ray_tpu importable)."""
+
+    name = "pip"
+    priority = 8  # venv resolves after working_dir/py_modules: shipped
+    # user code takes import precedence over installed packages
+
+    #: subclasses flip this to use the uv resolver/installer
+    use_uv = False
+
+    def validate(self, value):
+        if isinstance(value, str):
+            value = [value]
+        if isinstance(value, (list, tuple)):
+            value = {"packages": list(value)}
+        if not isinstance(value, dict) or not value.get("packages"):
+            raise ValueError(
+                f"{self.name} needs a package list or "
+                f"{{'packages': [...], 'find_links': dir}}")
+        pkgs = [str(p) for p in value["packages"]]
+        out = {"packages": sorted(pkgs)}
+        fl = value.get("find_links")
+        if fl is not None:
+            if not os.path.isdir(fl):
+                raise ValueError(f"find_links {fl!r} is not a directory")
+            out["find_links"] = os.path.abspath(fl)
+        if value.get("index_url"):
+            out["index_url"] = str(value["index_url"])
+        if "find_links" not in out and "index_url" not in out:
+            from ray_tpu.core.exceptions import RuntimeEnvSetupError
+
+            raise RuntimeEnvSetupError(
+                f"runtime_env[{self.name!r}]: this deployment has no "
+                f"package index (zero egress); provide a local wheel "
+                f"source via {{'packages': [...], 'find_links': dir}}")
+        return out
+
+    def upload(self, value, client, head_address):
+        out = dict(value)
+        fl = out.pop("find_links", None)
+        if fl is not None:
+            # ship the wheel dir once, content-addressed
+            out["wheels_key"] = _upload_blob(_zip_dir(fl), client,
+                                             head_address)
+        return out
+
+    def _env_dir(self, value) -> str:
+        import sys
+
+        h = hashlib.sha1(json.dumps(
+            [value["packages"], sys.version_info[:2], self.use_uv],
+            default=str).encode()).hexdigest()[:20]
+        base = os.environ.get("RAY_TPU_ENV_CACHE",
+                              "/tmp/ray_tpu/env_cache")
+        return os.path.join(base, self.name, h)
+
+    def materialize(self, value, ctx, session_dir, client, head_address):
+        import fcntl
+        import subprocess
+        import sys
+
+        from ray_tpu.core.exceptions import RuntimeEnvSetupError
+
+        dest = self._env_dir(value)
+        ready = os.path.join(dest, ".ready")
+        py = os.path.join(dest, "bin", "python")
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        with open(dest + ".lock", "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                if not os.path.exists(ready):
+                    wheels = None
+                    if value.get("wheels_key"):
+                        wheels = _fetch_extract(value["wheels_key"],
+                                                session_dir, client,
+                                                head_address)
+                    self._build_env(dest, py, value, wheels)
+                    with open(ready, "w") as f:
+                        f.write("ok")
+            except RuntimeEnvSetupError:
+                raise
+            except (OSError, subprocess.SubprocessError) as e:
+                raise RuntimeEnvSetupError(
+                    f"{self.name} env build failed: {e}") from e
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
+        ctx.py_exe = py
+        ctx.env["VIRTUAL_ENV"] = dest
+        ctx.env["PATH"] = (os.path.join(dest, "bin") + os.pathsep +
+                           os.environ.get("PATH", ""))
+        # site-packages on PYTHONPATH too: nested task submissions from
+        # this worker inherit visibility even without the interpreter
+        sp = os.path.join(dest, "lib",
+                          f"python{sys.version_info[0]}."
+                          f"{sys.version_info[1]}", "site-packages")
+        if os.path.isdir(sp):
+            ctx.py_paths.append(sp)
+
+    def _build_env(self, dest: str, py: str, value, wheels: str | None):
+        import shutil
+        import subprocess
+        import sys
+
+        from ray_tpu.core.exceptions import RuntimeEnvSetupError
+
+        if os.path.isdir(dest):
+            shutil.rmtree(dest, ignore_errors=True)  # partial build
+        uv = shutil.which("uv") if self.use_uv else None
+        if self.use_uv and uv is None:
+            # uv lifecycle requested but binary absent: same semantics
+            # through pip (documented fallback)
+            pass
+        if uv:
+            run = [uv, "venv", "--system-site-packages", "--python",
+                   sys.executable, dest]
+        else:
+            run = [sys.executable, "-m", "venv",
+                   "--system-site-packages", dest]
+        subprocess.run(run, check=True, capture_output=True, timeout=300)
+        # Inherit THIS interpreter's site dirs, not just the base
+        # python's: venv-from-a-venv sees only the base prefix under
+        # --system-site-packages, which would hide every package of the
+        # parent env (jax, cloudpickle, ray_tpu's .pth). addsitedir also
+        # re-processes the parent dirs' .pth files, and appends AFTER
+        # the venv's own site-packages so installed packages keep
+        # precedence.
+        import site as _site
+
+        sp = os.path.join(
+            dest, "lib", f"python{sys.version_info[0]}."
+            f"{sys.version_info[1]}", "site-packages")
+        parents = [p for p in _site.getsitepackages() if os.path.isdir(p)]
+        with open(os.path.join(sp, "_ray_tpu_parent_site.pth"), "w") as f:
+            for p in parents:
+                f.write(f"import site; site.addsitedir({p!r})\n")
+        if uv:
+            cmd = [uv, "pip", "install", "--python", py, "--offline"]
+        else:
+            cmd = [py, "-m", "pip", "install", "--no-input",
+                   "--disable-pip-version-check"]
+        if value.get("index_url"):
+            cmd += ["--index-url", value["index_url"]]
+            if uv:
+                cmd.remove("--offline")
+        else:
+            if not uv:
+                cmd += ["--no-index"]
+        if wheels:
+            cmd += ["--find-links", wheels]
+        elif not value.get("index_url"):
+            raise RuntimeEnvSetupError(
+                f"runtime_env[{self.name!r}]: this deployment has no "
+                f"package index (zero egress); provide a local wheel "
+                f"source via {{'packages': [...], 'find_links': dir}}")
+        cmd += value["packages"]
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=600)
+        if r.returncode != 0:
+            raise RuntimeEnvSetupError(
+                f"{self.name} install failed:\n{r.stdout}\n{r.stderr}")
+
+
+class UvPlugin(PipPlugin):
+    """uv-flavored env plugin (reference: _private/runtime_env/uv.py) —
+    same venv lifecycle, resolved/installed by `uv` when present (falls
+    back to pip with identical semantics if the binary is absent)."""
+
+    name = "uv"
+    priority = 8
+    use_uv = True
+
+
 class _GatedPlugin(RuntimeEnvPlugin):
-    """Reference plugins that require package installs, impossible in
-    this deployment; the field names are reserved so the error is
-    actionable rather than 'unknown key' (reference: pip.py, uv.py,
+    """Reference plugins whose materialization is impossible in this
+    deployment (no container runtime); the field names are reserved so
+    the error is actionable rather than 'unknown key' (reference:
     conda.py, container plugin)."""
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, why: str):
         self.name = name
+        self.why = why
 
     def validate(self, value):
         from ray_tpu.core.exceptions import RuntimeEnvSetupError
 
         raise RuntimeEnvSetupError(
-            f"runtime_env[{self.name!r}] requires installing packages at "
-            f"materialization time, which this deployment forbids (no "
-            f"network installs). Ship code with working_dir/py_modules "
-            f"instead.")
+            f"runtime_env[{self.name!r}] is unavailable: {self.why}")
 
 
 _REGISTRY: dict[str, RuntimeEnvPlugin] = {}
@@ -285,8 +472,11 @@ def registered_plugins() -> dict[str, RuntimeEnvPlugin]:
 
 
 for _p in (EnvVarsPlugin(), WorkingDirPlugin(), PyModulesPlugin(),
-           _GatedPlugin("pip"), _GatedPlugin("uv"), _GatedPlugin("conda"),
-           _GatedPlugin("container")):
+           PipPlugin(), UvPlugin(),
+           _GatedPlugin("conda", "conda is not installed on this image; "
+                        "use pip/uv with a local wheel source"),
+           _GatedPlugin("container", "no container runtime is available "
+                        "on this image")):
     register_plugin(_p)
 
 
@@ -323,13 +513,13 @@ def env_hash(norm: dict | None) -> str:
 
 
 def materialize(norm: dict | None, session_dir: str, client,
-                head_address: str) -> tuple[dict, str | None]:
+                head_address: str) -> tuple[dict, str | None, str | None]:
     """Node side: run every plugin in priority order against a fresh
-    context; returns (extra process env, cwd or None) for the worker
-    spawn (reference: the per-node runtime-env agent materializes
-    before WorkerPool starts the worker)."""
+    context; returns (extra process env, cwd or None, python exe or
+    None) for the worker spawn (reference: the per-node runtime-env
+    agent materializes before WorkerPool starts the worker)."""
     if not norm:
-        return {}, None
+        return {}, None, None
     ctx = RuntimeEnvContext()
     for name in sorted(norm, key=lambda n: _plugin(n).priority):
         _plugin(name).materialize(norm[name], ctx, session_dir, client,
@@ -339,4 +529,4 @@ def materialize(norm: dict | None, session_dir: str, client,
         prev = extra.get("PYTHONPATH", os.environ.get("PYTHONPATH", ""))
         joined = os.pathsep.join(ctx.py_paths)
         extra["PYTHONPATH"] = joined + (os.pathsep + prev if prev else "")
-    return extra, ctx.cwd
+    return extra, ctx.cwd, ctx.py_exe
