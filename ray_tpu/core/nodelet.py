@@ -103,6 +103,11 @@ class Nodelet:
         self.head_address = head_address
         self.resources = dict(resources)
         self.labels = dict(labels or {})
+        # every node is addressable by id through the label scheduler
+        # (reference: NodeAffinitySchedulingStrategy,
+        # node_affinity_scheduling_policy.h:29 — here node affinity IS a
+        # label match on this auto-label)
+        self.labels.setdefault("ray.io/node-id", self.node_id.hex())
         # slice identity: merge env-detected labels (real TPU VMs) under
         # any asserted ones, and assert the slice-head marker resource on
         # worker 0 (reference: accelerators/tpu.py TPU-{pod}-head)

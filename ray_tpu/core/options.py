@@ -90,6 +90,13 @@ def _normalize(d: dict) -> dict:
         d["placement_group"] = strat.placement_group
         d["placement_group_bundle_index"] = getattr(
             strat, "placement_group_bundle_index", -1)
+    elif strat is not None and hasattr(strat, "to_label_selector"):
+        # NodeAffinity / NodeLabel strategies lower to the label
+        # scheduler (nodes auto-carry "ray.io/node-id"); explicit
+        # selectors win on key conflicts
+        sel = dict(strat.to_label_selector())
+        sel.update(d.get("label_selector") or {})
+        d["label_selector"] = sel
     return d
 
 

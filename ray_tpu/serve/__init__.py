@@ -23,6 +23,8 @@ from ray_tpu.serve.api import (
     run,
     shutdown,
     start_proxy,
+    start_proxy_fleet,
+    status,
 )
 
 __all__ = [
@@ -37,4 +39,6 @@ __all__ = [
     "run",
     "shutdown",
     "start_proxy",
+    "start_proxy_fleet",
+    "status",
 ]

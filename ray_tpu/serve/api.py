@@ -197,6 +197,30 @@ class _Replica:
             with self._lock:
                 self._ongoing -= 1
 
+    def handle_stream_request(self, method: str, args, kwargs):
+        """Streaming variant: a GENERATOR method — called with
+        num_returns="streaming" so each yielded chunk ships to the
+        caller as produced (reference: replica response streaming over
+        the generator protocol, serve/_private/replica.py). Being a
+        generator itself keeps the ongoing-request count held until the
+        stream is drained or dropped, so autoscaling sees streams as
+        live load."""
+        with self._lock:
+            self._ongoing += 1
+        try:
+            if self._fn is not None:
+                result = self._fn(*args, **kwargs)
+            else:
+                result = getattr(self._instance, method)(*args, **kwargs)
+            if hasattr(result, "__iter__") and not isinstance(
+                    result, (str, bytes, dict, list, tuple)):
+                yield from result
+            else:
+                yield result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
     def ongoing(self) -> int:
         return self._ongoing
 
@@ -452,6 +476,33 @@ class DeploymentHandle:
 
         return call
 
+    def options(self, *, stream: bool = False) -> "DeploymentHandle":
+        """stream=True: calls return an ObjectRefGenerator — one ref per
+        chunk the deployment yields, delivered as produced (reference:
+        handle.options(stream=True), serve/handle.py)."""
+        if not stream:
+            return self
+        return _StreamingHandle(self)
+
+
+class _StreamingHandle:
+    """View over a DeploymentHandle whose calls ride the streaming
+    generator protocol (chunks consumable before the handler returns)."""
+
+    def __init__(self, base: DeploymentHandle):
+        self._base = base
+
+    def remote(self, *args, **kwargs):
+        return self._base._pick().handle_stream_request.options(
+            num_returns="streaming").remote("__call__", args, kwargs)
+
+    def method(self, name: str):
+        def call(*args, **kwargs):
+            return self._base._pick().handle_stream_request.options(
+                num_returns="streaming").remote(name, args, kwargs)
+
+        return call
+
 
 def _controller():
     import ray_tpu
@@ -541,42 +592,144 @@ class ProxyActor:
     """HTTP ingress as an ACTOR bound on the node IP — not a thread in
     the driver process (reference: per-node Proxy actors,
     _private/proxy.py). POST /<app> with a JSON body calls the app
-    handle; threads serve requests concurrently, each awaiting its own
-    ObjectRef, so one slow deployment call does not serialize the
-    ingress. Handle objects are cached per app (they refresh their
-    replica sets themselves)."""
+    handle; `?stream=1` (or X-Serve-Stream: 1) returns NDJSON chunks as
+    the deployment yields them, over the streaming generator protocol.
+    Threads serve requests concurrently, each awaiting its own
+    ObjectRef; an in-flight cap sheds load with 503 instead of queueing
+    unboundedly; request count/latency land in util.metrics and access
+    lines in the worker log (reference: proxy request metrics + access
+    logs, _private/proxy.py)."""
 
-    def __init__(self, port: int, host: str | None = None):
+    def __init__(self, port: int, host: str | None = None,
+                 max_inflight: int = 256):
         import json
+        import time as _t
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         import ray_tpu
         from ray_tpu.core.rpc import node_ip
+        from ray_tpu.util.metrics import Counter, Histogram
 
         proxy = self
+        self._inflight = 0
+        self._max_inflight = max_inflight
+        self._stats_lock = threading.Lock()
+        self._requests = Counter(
+            "serve_num_http_requests",
+            "HTTP requests through this proxy",
+            tag_keys=("app", "status"))
+        self._latency = Histogram(
+            "serve_http_request_latency_ms",
+            "End-to-end proxy request latency",
+            boundaries=(1, 5, 10, 50, 100, 500, 1000, 5000),
+            tag_keys=("app",))
+        self._totals = {"requests": 0, "errors": 0, "shed": 0,
+                        "streamed": 0}
 
         class Handler(BaseHTTPRequestHandler):
             daemon_threads = True
+            protocol_version = "HTTP/1.1"
 
             def do_POST(self):
-                app = self.path.strip("/") or "default"
-                length = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(length)
+                t0 = _t.perf_counter()
+                path, _, query = self.path.partition("?")
+                app = path.strip("/") or "default"
+                stream = ("stream=1" in query or
+                          self.headers.get("X-Serve-Stream") == "1")
+                with proxy._stats_lock:
+                    if proxy._inflight >= proxy._max_inflight:
+                        shed = True
+                    else:
+                        shed = False
+                        proxy._inflight += 1
+                if shed:
+                    with proxy._stats_lock:
+                        proxy._totals["shed"] += 1
+                    self._reply(503, {"error": "proxy at capacity"})
+                    proxy._requests.inc(tags={"app": app, "status": "503"})
+                    return
+                status = 200
                 try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(length)
                     payload = json.loads(body) if body else None
-                    ref = proxy._handle(app).remote(payload)
-                    result = ray_tpu.get(ref, timeout=120)
-                    out = json.dumps({"result": result}).encode()
-                    self.send_response(200)
+                    if stream:
+                        status = self._do_stream(app, payload)
+                        with proxy._stats_lock:
+                            proxy._totals["streamed"] += 1
+                    else:
+                        ref = proxy._handle(app).remote(payload)
+                        result = ray_tpu.get(ref, timeout=120)
+                        self._reply(200, {"result": result})
                 except Exception as e:  # noqa: BLE001
-                    out = json.dumps({"error": repr(e)}).encode()
-                    self.send_response(500)
+                    status = 500
+                    try:
+                        self._reply(500, {"error": repr(e)})
+                    except Exception:  # noqa: BLE001
+                        pass  # client gone mid-stream
+                finally:
+                    with proxy._stats_lock:
+                        proxy._inflight -= 1
+                        proxy._totals["requests"] += 1
+                        if status != 200:
+                            proxy._totals["errors"] += 1
+                    ms = (_t.perf_counter() - t0) * 1e3
+                    proxy._requests.inc(
+                        tags={"app": app, "status": str(status)})
+                    proxy._latency.observe(ms, tags={"app": app})
+                    # access log → worker log file
+                    print(f"[serve-proxy] {self.client_address[0]} "
+                          f"POST /{app} {status} {ms:.1f}ms"
+                          f"{' stream' if stream else ''}", flush=True)
+
+            def _do_stream(self, app: str, payload) -> int:
+                """NDJSON chunked response: one line per yielded chunk,
+                written as the replica produces it. Errors raised before
+                the first byte propagate (the caller sends a JSON 500);
+                after headers are out they become a terminal error line
+                — a second response on a chunked connection would
+                corrupt the protocol."""
+                gen = proxy._handle(app).options(stream=True).remote(
+                    payload)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(data: bytes):
+                    self.wfile.write(f"{len(data):X}\r\n".encode()
+                                     + data + b"\r\n")
+                    self.wfile.flush()
+
+                status = 200
+                try:
+                    for ref in gen:
+                        item = ray_tpu.get(ref, timeout=120)
+                        chunk((json.dumps({"result": item}) + "\n")
+                              .encode())
+                except Exception as e:  # noqa: BLE001
+                    status = 500
+                    try:
+                        chunk((json.dumps({"error": repr(e)}) + "\n")
+                              .encode())
+                    except Exception:  # noqa: BLE001
+                        pass  # client disconnected mid-stream
+                finally:
+                    try:
+                        self.wfile.write(b"0\r\n\r\n")
+                    except Exception:  # noqa: BLE001
+                        pass
+                return status
+
+            def _reply(self, code: int, obj: dict):
+                out = json.dumps(obj).encode()
+                self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(out)))
                 self.end_headers()
                 self.wfile.write(out)
 
-            def log_message(self, *a):  # quiet
+            def log_message(self, *a):  # access log handled above
                 pass
 
         # Bind scope: loopback by default. Cross-host ingress requires the
@@ -607,6 +760,20 @@ class ProxyActor:
     def get_address(self) -> str:
         return self.address
 
+    def get_metrics(self) -> dict:
+        """Request totals for serve.status()/the state API."""
+        import ray_tpu
+
+        with self._stats_lock:
+            out = dict(self._totals)
+        out["inflight"] = self._inflight
+        out["node_id"] = ray_tpu.get_runtime_context().node_id.hex()
+        out["address"] = self.address
+        return out
+
+    def ping(self) -> str:
+        return "pong"
+
     def stop(self) -> bool:
         self._server.shutdown()
         return True
@@ -618,8 +785,37 @@ def start_proxy(port: int = 8000, host: str | None = None) -> str:
 
     cls = ray_tpu.remote(num_cpus=0)(ProxyActor)
     proxy = cls.options(name=_PROXY_NAME, get_if_exists=True,
-                        max_concurrency=4).remote(port, host)
+                        max_concurrency=32).remote(port, host)
     return ray_tpu.get(proxy.get_address.remote(), timeout=60)
+
+
+def start_proxy_fleet(port: int = 8000, host: str | None = None
+                      ) -> dict[str, str]:
+    """One ingress proxy PER ALIVE NODE, each pinned by node affinity
+    and bound on its own node's IP (reference: the proxy runs on every
+    node, serve/_private/proxy.py + default_impl.py). Returns
+    {node_id_hex: "ip:port"}. Idempotent: existing per-node proxies are
+    reused; nodes added later get one on the next call."""
+    import ray_tpu
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    cls = ray_tpu.remote(num_cpus=0)(ProxyActor)
+    out: dict[str, str] = {}
+    handles = {}
+    for node in ray_tpu.nodes():
+        if not node["Alive"]:
+            continue
+        nid = node["NodeID"]
+        handles[nid] = cls.options(
+            name=f"{_PROXY_NAME}:{nid[:12]}", get_if_exists=True,
+            max_concurrency=32,
+            scheduling_strategy=NodeAffinitySchedulingStrategy(nid),
+        ).remote(port, host)
+    for nid, h in handles.items():
+        out[nid] = ray_tpu.get(h.get_address.remote(), timeout=60)
+    return out
 
 
 def proxy_address() -> str:
@@ -629,16 +825,47 @@ def proxy_address() -> str:
     return ray_tpu.get(proxy.get_address.remote(), timeout=30)
 
 
-def _stop_http_proxy():
+def _iter_proxies():
     import ray_tpu
 
     try:
-        proxy = ray_tpu.get_actor(_PROXY_NAME)
-    except Exception:  # noqa: BLE001
-        return
-    try:
-        ray_tpu.get(proxy.stop.remote(), timeout=30)
-        ray_tpu.kill(proxy)
+        yield ray_tpu.get_actor(_PROXY_NAME)
     except Exception:  # noqa: BLE001
         pass
+    for node in ray_tpu.nodes():
+        try:
+            yield ray_tpu.get_actor(f"{_PROXY_NAME}:{node['NodeID'][:12]}")
+        except Exception:  # noqa: BLE001
+            continue
+
+
+def status() -> dict:
+    """Apps + per-proxy request metrics (reference: serve.status(); the
+    state API surfaces the same through util/state.serve_status)."""
+    import ray_tpu
+
+    out: dict = {"apps": {}, "proxies": []}
+    try:
+        ctrl = ray_tpu.get_actor(_CONTROLLER_NAME)
+        out["apps"] = ray_tpu.get(ctrl.list_apps.remote(), timeout=30)
+    except Exception:  # noqa: BLE001
+        pass
+    for proxy in _iter_proxies():
+        try:
+            out["proxies"].append(
+                ray_tpu.get(proxy.get_metrics.remote(), timeout=10))
+        except Exception:  # noqa: BLE001
+            continue
+    return out
+
+
+def _stop_http_proxy():
+    import ray_tpu
+
+    for proxy in _iter_proxies():
+        try:
+            ray_tpu.get(proxy.stop.remote(), timeout=30)
+            ray_tpu.kill(proxy)
+        except Exception:  # noqa: BLE001
+            pass
 

@@ -1,0 +1,38 @@
+"""Scheduling strategies (reference:
+python/ray/util/scheduling_strategies.py — NodeAffinity / NodeLabel
+strategies; PlacementGroupSchedulingStrategy lives in
+util/placement_group.py).
+
+On this framework node affinity lowers to a LABEL MATCH: every nodelet
+auto-labels itself "ray.io/node-id"=<hex id> (reference:
+node_affinity_scheduling_policy.h:29), so the one label scheduler
+serves explicit selectors, node affinity, and TPU-slice gangs alike.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class NodeAffinitySchedulingStrategy:
+    """Pin a task/actor to one node by id (reference:
+    scheduling_strategies.py:58). `soft=True` allows fallback anywhere
+    if the node is gone; hard affinity fails the placement instead."""
+
+    node_id: str
+    soft: bool = False
+
+    def to_label_selector(self) -> dict[str, str]:
+        return {"ray.io/node-id": self.node_id}
+
+
+@dataclasses.dataclass
+class NodeLabelSchedulingStrategy:
+    """Schedule onto any node whose labels match (reference:
+    scheduling_strategies.py NodeLabelSchedulingStrategy hard match)."""
+
+    hard: dict[str, str]
+
+    def to_label_selector(self) -> dict[str, str]:
+        return dict(self.hard)

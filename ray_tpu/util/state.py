@@ -190,3 +190,12 @@ def summarize(address: str | None = None) -> dict:
         "resources_total": total,
         "resources_available": avail,
     }
+
+
+def serve_status(address: str | None = None) -> dict:
+    """Serve apps + per-proxy request metrics (reference: `ray serve
+    status` / the serve state surface). Requires an initialized runtime
+    (the serve control plane lives in actors, not the head tables)."""
+    from ray_tpu import serve
+
+    return serve.status()

@@ -286,3 +286,90 @@ def test_handle_version_monotonic_across_redeploys(cluster):
     v2 = ray_tpu.get(ctrl.get_replicas.remote("ver"), timeout=30)["version"]
     assert v2 > v1, (v1, v2)
     serve.delete("ver")
+
+
+# ---------------------------------------------------------------------------
+# Streaming responses + per-node proxy fleet (VERDICT r4 item 6)
+# Reference: serve/_private/proxy.py (proxy per node, response
+# streaming), serve/handle.py (handle.options(stream=True))
+
+
+def test_streaming_handle(cluster):
+    import time
+
+    @serve.deployment
+    class Tokens:
+        def __call__(self, n):
+            for i in range(n):
+                yield f"tok{i}"
+                time.sleep(0.05)
+
+    h = serve.run(Tokens.bind(), name="tok")
+    gen = h.options(stream=True).remote(4)
+    t0 = time.monotonic()
+    first = ray_tpu.get(next(gen))
+    dt = time.monotonic() - t0
+    assert first == "tok0"
+    assert dt < 2.0, f"first chunk took {dt:.1f}s — not streamed"
+    rest = [ray_tpu.get(r) for r in gen]
+    assert rest == ["tok1", "tok2", "tok3"]
+    serve.delete("tok")
+
+
+def test_http_streaming_endpoint(cluster):
+    import time
+
+    @serve.deployment
+    class Chunks:
+        def __call__(self, body):
+            for i in range(3):
+                yield {"chunk": i}
+                time.sleep(0.3)
+
+    serve.run(Chunks.bind(), name="chunks")
+    addr = serve.start_proxy(port=0)
+    url = f"http://{addr}/chunks?stream=1"
+    req = urllib.request.Request(
+        url, data=b"null", headers={"Content-Type": "application/json"})
+    t0 = time.monotonic()
+    resp = urllib.request.urlopen(req, timeout=60)
+    first_line = resp.readline()
+    t_first = time.monotonic() - t0
+    assert json.loads(first_line)["result"] == {"chunk": 0}
+    assert t_first < 3.0, f"first chunk after {t_first:.1f}s — buffered"
+    lines = [json.loads(l) for l in resp.read().splitlines() if l.strip()]
+    assert [l["result"]["chunk"] for l in lines] == [1, 2]
+    serve.delete("chunks")
+
+
+def test_proxy_fleet_two_nodes_and_state_metrics(cluster):
+    """Proxies on BOTH nodes (node-affinity pinned), each serving HTTP,
+    with request metrics visible through the state API (reference:
+    per-node proxies, _private/proxy.py + default_impl.py)."""
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+
+    @serve.deployment(num_replicas=2)
+    class Echo:
+        def __call__(self, body):
+            return {"echo": body}
+
+    serve.run(Echo.bind(), name="fleet-echo")
+    fleet = serve.start_proxy_fleet(port=0)
+    assert len(fleet) >= 2, f"expected >=2 node proxies, got {fleet}"
+    node_ids = set(fleet)
+    assert len(node_ids) == len(fleet)  # one per distinct node
+    for nid, addr in fleet.items():
+        req = urllib.request.Request(
+            f"http://{addr}/fleet-echo", data=json.dumps(42).encode(),
+            headers={"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req, timeout=60).read())
+        assert out["result"] == {"echo": 42}
+    from ray_tpu.util.state import serve_status
+
+    st = serve_status()
+    assert "fleet-echo" in st["apps"]
+    by_node = {p["node_id"]: p for p in st["proxies"]}
+    for nid in fleet:
+        assert by_node[nid]["requests"] >= 1, by_node
+    serve.delete("fleet-echo")
