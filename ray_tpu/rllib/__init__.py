@@ -13,6 +13,7 @@ MultiAgentEnv), and nine algorithm families: PPO, APPO, IMPALA,
 DQN (+PER), SAC, CQL, DreamerV3, BC, MARWIL.
 """
 
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.catalog import Catalog
 from ray_tpu.rllib.cql import CQL, CQLConfig, record_continuous_experiences
@@ -45,11 +46,21 @@ from ray_tpu.rllib.offline import (
 )
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.replay import PrioritizedReplayBuffer, SumTree
+from ray_tpu.rllib.rl_module import (
+    DefaultActorCriticModule,
+    RLModule,
+    RLModuleSpec,
+)
 from ray_tpu.rllib.sac import SAC, SACConfig
 
 __all__ = [
     "APPO",
     "APPOConfig",
+    "Algorithm",
+    "AlgorithmConfig",
+    "DefaultActorCriticModule",
+    "RLModule",
+    "RLModuleSpec",
     "BC",
     "BCConfig",
     "MARWILConfig",

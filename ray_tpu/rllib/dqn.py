@@ -59,13 +59,12 @@ class ReplayBuffer:
         }
 
 
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+
+
 @dataclasses.dataclass
-class DQNConfig:
-    env: str = "CartPole-v1"
-    num_env_runners: int = 0
-    num_envs_per_env_runner: int = 8
+class DQNConfig(AlgorithmConfig):
     rollout_fragment_length: int = 16
-    gamma: float = 0.99
     lr: float = 5e-4
     buffer_capacity: int = 50_000
     train_batch_size: int = 64
@@ -76,51 +75,29 @@ class DQNConfig:
     epsilon_initial: float = 1.0
     epsilon_final: float = 0.05
     epsilon_decay_steps: int = 10_000
-    hidden: tuple = (64, 64)
     # proportional prioritized replay (reference: PER via segment trees,
     # rllib/execution/segment_tree.py + prioritized_episode_buffer)
     prioritized_replay: bool = False
     per_alpha: float = 0.6
     per_beta: float = 0.4
-    seed: int = 0
-
-    def environment(self, env: str) -> "DQNConfig":
-        self.env = env
-        return self
-
-    def env_runners(self, **kw) -> "DQNConfig":
-        for k, v in kw.items():
-            if not hasattr(self, k):
-                raise ValueError(f"unknown option {k!r}")
-            setattr(self, k, v)
-        return self
-
-    def training(self, **kw) -> "DQNConfig":
-        for k, v in kw.items():
-            if not hasattr(self, k):
-                raise ValueError(f"unknown option {k!r}")
-            setattr(self, k, v)
-        return self
 
     def build(self) -> "DQN":
         return DQN(self)
 
 
-from ray_tpu.rllib.checkpointable import Checkpointable
-
-
-class DQN(Checkpointable):
+class DQN(Algorithm):
     """Epsilon-greedy sampling rides the PPO env-runner machinery: the
     runner samples with a stochastic policy head; DQN overrides sampled
     actions toward greedy as epsilon decays by syncing a temperature-less
     Q-head (the categorical over Q-logits acts as exploration — with
     epsilon mixed in on the learner-side weight sync)."""
 
+    config_class = DQNConfig
     STATE_COMPONENTS = ("params", "target_params", "opt_state",
-                        "_env_steps", "_updates", "_iteration")
+                        "_env_steps", "_updates", "_iteration",
+                        "_timesteps_total")
 
-    def __init__(self, config: DQNConfig):
-        self.config = config
+    def setup(self, config: DQNConfig):
         import gymnasium as gym
 
         probe = gym.make(config.env)
@@ -145,7 +122,6 @@ class DQN(Checkpointable):
         self._rng = np.random.RandomState(config.seed)
         self._env_steps = 0
         self._updates = 0
-        self._iteration = 0
 
         self.env_runner_group = EnvRunnerGroup(
             num_env_runners=config.num_env_runners,
@@ -211,7 +187,7 @@ class DQN(Checkpointable):
 
     # -- training --------------------------------------------------------
 
-    def train(self) -> dict:
+    def training_step(self) -> dict:
         cfg = self.config
         t0 = time.perf_counter()
         samples = self.env_runner_group.sample()
@@ -264,10 +240,8 @@ class DQN(Checkpointable):
                 if self._updates % cfg.target_update_freq == 0:
                     self.target_params = jax.tree.map(jnp.copy, self.params)
         self._sync_runner_weights()
-        self._iteration += 1
         dt = time.perf_counter() - t0
         return {
-            "training_iteration": self._iteration,
             "episode_return_mean": float(np.mean(ep_returns))
             if ep_returns else float("nan"),
             "num_env_steps_sampled_lifetime": self._env_steps,
@@ -278,5 +252,8 @@ class DQN(Checkpointable):
             "buffer_size": len(self.buffer),
         }
 
-    def stop(self):
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def cleanup(self):
         self.env_runner_group.shutdown()

@@ -18,7 +18,8 @@ class SingleAgentEnvRunner:
     def __init__(self, env: str = "CartPole-v1", num_envs: int = 1,
                  rollout_fragment_length: int = 200, seed: int = 0,
                  hidden=(64, 64), framestack: int = 1,
-                 model_config: dict | None = None):
+                 model_config: dict | None = None,
+                 module_spec=None):
         import gymnasium as gym
         import jax
 
@@ -46,15 +47,18 @@ class SingleAgentEnvRunner:
         self._models = models
         mc = dict(model_config or {})
         mc.setdefault("hidden", tuple(hidden))
-        if self._image:
-            self.params = models.init_actor_critic(
-                jax.random.PRNGKey(seed), self.obs_shape, self.n_actions,
-                mc)
-        else:
-            self.params = models.init_mlp_policy(
-                jax.random.PRNGKey(seed), self.obs_dim, self.n_actions,
-                mc["hidden"])
-        self._sample_fn = jax.jit(models.sample_actions)
+        # RLModule seam (reference: the runner builds its module from an
+        # RLModuleSpec, single_agent_env_runner.py make_module): default
+        # is the catalog actor-critic; algorithms may ship a custom spec
+        if module_spec is None:
+            from ray_tpu.rllib.rl_module import RLModuleSpec
+
+            module_spec = RLModuleSpec(
+                obs_spec=self.obs_shape if self._image else self.obs_dim,
+                n_actions=self.n_actions, model_config=mc)
+        self.module = module_spec.build()
+        self.params = self.module.init(jax.random.PRNGKey(seed))
+        self._sample_fn = jax.jit(self.module.explore)
         self._key = jax.random.PRNGKey(seed + 1)
         raw_obs, _ = self.envs.reset(seed=seed)
         self.obs = self.pipeline(raw_obs)

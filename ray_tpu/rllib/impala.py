@@ -52,39 +52,18 @@ def vtrace(behavior_logp, target_logp, rewards, values, dones, last_values,
     return vs, advantages
 
 
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+
+
 @dataclasses.dataclass
-class IMPALAConfig:
-    env: str = "CartPole-v1"
+class IMPALAConfig(AlgorithmConfig):
     num_env_runners: int = 2
-    num_envs_per_env_runner: int = 8
-    rollout_fragment_length: int = 64
-    gamma: float = 0.99
     lr: float = 5e-4
     entropy_coeff: float = 0.01
     vf_loss_coeff: float = 0.5
     grad_clip: float = 40.0
     queue_capacity: int = 8
     broadcast_interval: int = 1  # learner steps between weight syncs
-    hidden: tuple = (64, 64)
-    seed: int = 0
-
-    def environment(self, env: str) -> "IMPALAConfig":
-        self.env = env
-        return self
-
-    def env_runners(self, **kw) -> "IMPALAConfig":
-        for k, v in kw.items():
-            if not hasattr(self, k):
-                raise ValueError(f"unknown option {k!r}")
-            setattr(self, k, v)
-        return self
-
-    def training(self, **kw) -> "IMPALAConfig":
-        for k, v in kw.items():
-            if not hasattr(self, k):
-                raise ValueError(f"unknown option {k!r}")
-            setattr(self, k, v)
-        return self
 
     def build(self) -> "IMPALA":
         return IMPALA(self)
@@ -125,9 +104,27 @@ class _LearnerThread(threading.Thread):
                 return
 
 
-class IMPALA:
-    def __init__(self, config: IMPALAConfig):
-        self.config = config
+class IMPALA(Algorithm):
+    config_class = IMPALAConfig
+    STATE_COMPONENTS = ("_iteration", "_timesteps_total", "_env_steps")
+
+    def get_state(self) -> dict:
+        state = super().get_state()
+        with self._params_lock:
+            state["learner"] = {
+                "params": jax.tree.map(np.asarray, self.params)}
+        return state
+
+    def set_state(self, state: dict):
+        super().set_state(state)
+        if "learner" in state:
+            with self._params_lock:
+                self.params = jax.tree.map(
+                    jnp.asarray, state["learner"]["params"])
+            self.env_runner_group.sync_weights(
+                state["learner"]["params"])
+
+    def setup(self, config: IMPALAConfig):
         import gymnasium as gym
 
         probe = gym.make(config.env)
@@ -184,7 +181,6 @@ class IMPALA:
         self.learner_thread = _LearnerThread(self)
         self.learner_thread.start()
         self._inflight: dict = {}
-        self._iteration = 0
         self._env_steps = 0
         self._ep_returns: list[float] = []
 
@@ -217,7 +213,7 @@ class IMPALA:
             "mask": jnp.asarray(mask),
         }
 
-    def train(self) -> dict:
+    def training_step(self) -> dict:
         """One driver iteration: harvest landed samples, keep one
         in-flight per runner, feed the learner queue (reference:
         IMPALA.training_step's async path)."""
@@ -269,12 +265,10 @@ class IMPALA:
             group.sync_weights(jax.tree.map(np.asarray, params))
 
         self._env_steps += env_steps
-        self._iteration += 1
         dt = time.perf_counter() - t0
         window = self._ep_returns[-100:]
         self._ep_returns = window
         return {
-            "training_iteration": self._iteration,
             "episode_return_mean": float(np.mean(window)) if window
             else float("nan"),
             "num_env_steps_sampled_lifetime": self._env_steps,
@@ -284,6 +278,10 @@ class IMPALA:
             "learner_queue_size": self._queue.qsize(),
         }
 
-    def stop(self):
+    def get_weights(self):
+        with self._params_lock:
+            return jax.tree.map(np.asarray, self.params)
+
+    def cleanup(self):
         self.learner_thread.stopped.set()
         self.env_runner_group.shutdown()

@@ -19,8 +19,6 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ray_tpu.rllib import models
-
 
 @dataclasses.dataclass
 class PPOLearnerConfig:
@@ -61,7 +59,8 @@ class PPOLearner:
 
     def __init__(self, obs_dim, n_actions: int,
                  config: PPOLearnerConfig | None = None, mesh=None,
-                 seed: int = 0, model_config: dict | None = None):
+                 seed: int = 0, model_config: dict | None = None,
+                 module=None):
         self.config = config or PPOLearnerConfig()
         self.mesh = mesh
         self.tx = optax.chain(
@@ -69,23 +68,25 @@ class PPOLearner:
             optax.adam(self.config.lr),
         )
         # obs_dim: int (vector, legacy towers) or a 3-tuple image shape
-        # (catalog conv actor-critic — core/models/catalog.py:33)
+        # (catalog conv actor-critic — core/models/catalog.py:33);
+        # the RLModule owns the net (reference: Learner builds its module
+        # from the spec, core/learner/learner.py) — runner and learner
+        # construct identical modules so weight sync is a pytree copy
         mc = dict(model_config or {})
         mc.setdefault("hidden", self.config.hidden)
-        if isinstance(obs_dim, tuple) and len(obs_dim) == 3:
-            self.params = models.init_actor_critic(
-                jax.random.PRNGKey(seed), obs_dim, n_actions, mc)
-        else:
-            # honor a model_config hidden override for vector spaces too
-            # (the runner builds the same shape; weights are then synced)
-            self.params = models.init_mlp_policy(
-                jax.random.PRNGKey(seed), int(obs_dim), n_actions,
-                tuple(mc["hidden"]))
+        if module is None:
+            from ray_tpu.rllib.rl_module import DefaultActorCriticModule
+
+            module = DefaultActorCriticModule(obs_dim, n_actions, mc)
+        self.module = module
+        self.params = self.module.init(jax.random.PRNGKey(seed))
         self.opt_state = self.tx.init(self.params)
         cfg = self.config
+        fwd = self.module.forward_train
 
         def loss_fn(params, batch):
-            logits, value = models.forward(params, batch["obs"])
+            out = fwd(params, batch)
+            logits, value = out["action_dist_inputs"], out["vf_preds"]
             logp_all = jax.nn.log_softmax(logits)
             logp = jnp.take_along_axis(
                 logp_all, batch["actions"][:, None], axis=1)[:, 0]

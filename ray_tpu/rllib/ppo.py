@@ -15,31 +15,23 @@ from typing import Any
 
 import numpy as np
 
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.env_runner import EnvRunnerGroup
 from ray_tpu.rllib.learner import PPOLearner, PPOLearnerConfig
 
 
 @dataclasses.dataclass
-class PPOConfig:
-    """Fluent builder (reference: AlgorithmConfig —
+class PPOConfig(AlgorithmConfig):
+    """Fluent builder (reference: PPOConfig over AlgorithmConfig —
     .environment().env_runners().training())."""
 
-    env: str = "CartPole-v1"
     num_env_runners: int = 2
-    num_envs_per_env_runner: int = 8
-    rollout_fragment_length: int = 64
-    gamma: float = 0.99
     lambda_: float = 0.95
-    lr: float = 3e-4
     clip_param: float = 0.2
     vf_loss_coeff: float = 0.5
     entropy_coeff: float = 0.0
     num_sgd_iter: int = 6
     minibatch_size: int = 128
-    hidden: tuple = (64, 64)
-    framestack: int = 1  # >1: FrameStack connector on image obs
-    model_config: dict | None = None  # catalog overrides (conv_filters..)
-    seed: int = 0
     num_learners: int = 0  # >1: learner mesh of that many devices
     learner_mesh: Any = None  # or pass an explicit jax Mesh
     # Overlap sampling with the jitted update (reference: the async
@@ -49,22 +41,6 @@ class PPOConfig:
     # which PPO's clipped importance ratio absorbs. Pays off when the
     # learner runs on an accelerator while envs step on host CPU.
     pipeline_sampling: bool = False
-
-    def environment(self, env: str) -> "PPOConfig":
-        self.env = env
-        return self
-
-    def env_runners(self, num_env_runners: int | None = None,
-                    num_envs_per_env_runner: int | None = None,
-                    rollout_fragment_length: int | None = None
-                    ) -> "PPOConfig":
-        if num_env_runners is not None:
-            self.num_env_runners = num_env_runners
-        if num_envs_per_env_runner is not None:
-            self.num_envs_per_env_runner = num_envs_per_env_runner
-        if rollout_fragment_length is not None:
-            self.rollout_fragment_length = rollout_fragment_length
-        return self
 
     def learners(self, num_learners: int = 0) -> "PPOConfig":
         """num_learners>1 maps to a LEARNER MESH of that many devices
@@ -94,24 +70,18 @@ class PPOConfig:
                 f"devices")
         return build_mesh(MeshSpec(data=self.num_learners), devices=devices)
 
-    def training(self, **kwargs) -> "PPOConfig":
-        for k, v in kwargs.items():
-            if not hasattr(self, k):
-                raise ValueError(f"unknown training option {k!r}")
-            setattr(self, k, v)
-        return self
-
     def build(self) -> "PPO":
         return PPO(self)
 
 
-from ray_tpu.rllib.checkpointable import Checkpointable
+class PPO(Algorithm):
+    """Algorithm driver (reference: Algorithm.step → PPO.training_step
+    :389 — sample, learn, sync; the shared train/eval/checkpoint
+    skeleton lives in the Algorithm base)."""
 
-
-class PPO(Checkpointable):
-    """Algorithm driver (reference: Algorithm.step → PPO.training_step)."""
-
-    STATE_COMPONENTS = ("_iteration", "_env_steps_total")
+    config_class = PPOConfig
+    STATE_COMPONENTS = ("_iteration", "_timesteps_total",
+                        "_env_steps_total")
 
     def get_state(self) -> dict:
         state = super().get_state()
@@ -124,8 +94,7 @@ class PPO(Checkpointable):
             self.learner.set_weights(state["learner"]["params"])
             self.env_runner_group.sync_weights(self.learner.get_weights())
 
-    def __init__(self, config: PPOConfig):
-        self.config = config
+    def setup(self, config: PPOConfig):
         self.env_runner_group = EnvRunnerGroup(
             num_env_runners=config.num_env_runners,
             remote=config.num_env_runners > 0,
@@ -171,11 +140,6 @@ class PPO(Checkpointable):
             mesh=config._resolve_learner_mesh(), seed=config.seed,
             model_config=config.model_config)
         self.env_runner_group.sync_weights(self.learner.get_weights())
-        from ray_tpu.rllib.metrics import MetricsLogger
-
-        # hierarchical windowed metrics (reference: metrics_logger.py)
-        self.metrics = MetricsLogger()
-        self._iteration = 0
         self._env_steps_total = 0
         # pipeline_sampling state: the fragment prefetched during the
         # previous iteration's update, and a one-thread executor for the
@@ -214,7 +178,6 @@ class PPO(Checkpointable):
 
     def _finish_iteration(self, t0, t_sample, t_learn, ep_returns, n_eps,
                           env_steps, learner_metrics) -> dict:
-        self._iteration += 1
         self._env_steps_total += env_steps
         dt = time.perf_counter() - t0
         if ep_returns:
@@ -224,7 +187,6 @@ class PPO(Checkpointable):
                                env_steps, reduce="sum", window=None)
         self.metrics.log_dict(learner_metrics, key="learner", window=20)
         return {
-            "training_iteration": self._iteration,
             "episode_return_mean": float(np.mean(ep_returns))
             if ep_returns else float("nan"),
             "num_episodes": n_eps,
@@ -236,7 +198,7 @@ class PPO(Checkpointable):
             **{f"learner/{k}": v for k, v in learner_metrics.items()},
         }
 
-    def train(self) -> dict:
+    def training_step(self) -> dict:
         """One training iteration (reference: PPO.training_step,
         ppo.py:389 — sample, learn, sync)."""
         if self.config.pipeline_sampling:
@@ -285,7 +247,7 @@ class PPO(Checkpointable):
     def get_weights(self):
         return self.learner.get_weights()
 
-    def stop(self):
+    def cleanup(self):
         if self._learn_executor is not None:
             self._learn_executor.shutdown(wait=False)
             self._learn_executor = None

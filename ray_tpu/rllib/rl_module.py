@@ -1,0 +1,112 @@
+"""RLModule — the neural-network abstraction of the new API stack.
+
+Reference parity: rllib/core/rl_module/rl_module.py:260 (RLModule with
+forward_inference / forward_exploration / forward_train) and
+RLModuleSpec (:65 — build() from observation/action spaces + model
+config). The torch nn.Module becomes a FUNCTIONAL module: params are a
+jax pytree created by `init`, every forward is a pure function of
+(params, batch) — so the same module runs jitted on the learner mesh and
+on CPU inside env-runner actors, and weight sync is a plain pytree
+broadcast instead of a state_dict copy.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class RLModule(abc.ABC):
+    """Functional policy/value module. Subclasses define the param
+    pytree (`init`) and the three forward passes; defaults derive
+    inference (greedy) and exploration (sampled) from `forward_train`'s
+    action logits."""
+
+    @abc.abstractmethod
+    def init(self, key) -> dict:
+        """Create the parameter pytree."""
+
+    @abc.abstractmethod
+    def forward_train(self, params: dict, batch: dict) -> dict:
+        """Training forward: returns at least {"action_dist_inputs",
+        "vf_preds"} (reference: forward_train output keys)."""
+
+    def forward_inference(self, params: dict, batch: dict) -> dict:
+        """Greedy action selection (reference: forward_inference —
+        deterministic, used for evaluation/serving)."""
+        out = self.forward_train(params, batch)
+        out["actions"] = jnp.argmax(out["action_dist_inputs"], axis=-1)
+        return out
+
+    def forward_exploration(self, params: dict, batch: dict, key) -> dict:
+        """Stochastic action selection (reference: forward_exploration —
+        used by env runners while sampling)."""
+        out = self.forward_train(params, batch)
+        logits = out["action_dist_inputs"]
+        actions = jax.random.categorical(key, logits)
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits), actions[:, None], axis=1)[:, 0]
+        out["actions"] = actions
+        out["action_logp"] = logp
+        return out
+
+    # -- flat helpers for the env-runner hot loop -------------------------
+
+    def explore(self, params, obs, key):
+        """(action, logp, value) triple — the env runner's jitted
+        sampling signature."""
+        out = self.forward_exploration(params, {"obs": obs}, key)
+        return out["actions"], out["action_logp"], out["vf_preds"]
+
+    def infer(self, params, obs):
+        out = self.forward_inference(params, {"obs": obs})
+        return out["actions"]
+
+
+class DefaultActorCriticModule(RLModule):
+    """Catalog-backed discrete actor-critic: conv encoder for image
+    spaces, MLP towers for vectors (reference: DefaultPPORLModule +
+    catalog.py:33 encoder selection)."""
+
+    def __init__(self, obs_spec, n_actions: int,
+                 model_config: dict | None = None):
+        from ray_tpu.rllib import models
+
+        self.obs_spec = obs_spec
+        self.n_actions = int(n_actions)
+        self.model_config = dict(model_config or {})
+        self.model_config.setdefault("hidden", (64, 64))
+        self._models = models
+
+    def init(self, key) -> dict:
+        m = self._models
+        if isinstance(self.obs_spec, tuple) and len(self.obs_spec) == 3:
+            return m.init_actor_critic(key, self.obs_spec, self.n_actions,
+                                       self.model_config)
+        return m.init_mlp_policy(key, int(np.prod(self.obs_spec)),
+                                 self.n_actions,
+                                 tuple(self.model_config["hidden"]))
+
+    def forward_train(self, params: dict, batch: dict) -> dict:
+        logits, value = self._models.forward(params, batch["obs"])
+        return {"action_dist_inputs": logits, "vf_preds": value}
+
+
+@dataclasses.dataclass
+class RLModuleSpec:
+    """Build recipe (reference: RLModuleSpec — module class + spaces +
+    model config, resolved inside learners and env runners so actors
+    construct identical modules from plain data)."""
+
+    module_class: type = DefaultActorCriticModule
+    obs_spec: tuple | int = 4
+    n_actions: int = 2
+    model_config: dict | None = None
+
+    def build(self) -> RLModule:
+        return self.module_class(self.obs_spec, self.n_actions,
+                                 self.model_config)

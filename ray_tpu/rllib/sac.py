@@ -68,13 +68,14 @@ def q_values(params, obs, act):
     return _mlp(params["q1"], x)[..., 0], _mlp(params["q2"], x)[..., 0]
 
 
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+
+
 @dataclasses.dataclass
-class SACConfig:
+class SACConfig(AlgorithmConfig):
     env: str = "Pendulum-v1"
     num_envs: int = 8
     rollout_fragment_length: int = 8
-    gamma: float = 0.99
-    lr: float = 3e-4
     tau: float = 0.005  # polyak
     buffer_capacity: int = 100_000
     train_batch_size: int = 256
@@ -83,34 +84,19 @@ class SACConfig:
     hidden: tuple = (256, 256)
     initial_alpha: float = 1.0
     target_entropy: float | None = None  # default: -act_dim
-    seed: int = 0
-
-    def environment(self, env: str) -> "SACConfig":
-        self.env = env
-        return self
-
-    def training(self, **kw) -> "SACConfig":
-        for k, v in kw.items():
-            if not hasattr(self, k):
-                raise ValueError(f"unknown option {k!r}")
-            setattr(self, k, v)
-        return self
 
     def build(self) -> "SAC":
         return SAC(self)
 
 
-from ray_tpu.rllib.checkpointable import Checkpointable
-
-
-class SAC(Checkpointable):
+class SAC(Algorithm):
+    config_class = SACConfig
     STATE_COMPONENTS = ("params", "target_q", "log_alpha",
-                        "_env_steps", "_iteration")
+                        "_env_steps", "_iteration", "_timesteps_total")
 
-    def __init__(self, config: SACConfig):
+    def setup(self, config: SACConfig):
         import gymnasium as gym
 
-        self.config = config
         cfg = config
         self.envs = gym.make_vec(cfg.env, num_envs=cfg.num_envs)
         space = self.envs.single_action_space
@@ -193,13 +179,12 @@ class SAC(Checkpointable):
         self._ep_returns = np.zeros(cfg.num_envs)
         self._completed: list[float] = []
         self._env_steps = 0
-        self._iteration = 0
 
     def _scale(self, a: np.ndarray) -> np.ndarray:
         return self._act_low + (a + 1.0) * 0.5 * (self._act_high -
                                                   self._act_low)
 
-    def train(self) -> dict:
+    def training_step(self) -> dict:
         cfg = self.config
         t0 = time.perf_counter()
         for _ in range(cfg.rollout_fragment_length):
@@ -242,12 +227,10 @@ class SAC(Checkpointable):
                 c_losses.append(float(cl))
                 a_losses.append(float(al))
 
-        self._iteration += 1
         window = self._completed[-100:]
         self._completed = window
         dt = time.perf_counter() - t0
         return {
-            "training_iteration": self._iteration,
             "episode_return_mean": float(np.mean(window)) if window
             else float("nan"),
             "num_env_steps_sampled_lifetime": self._env_steps,
@@ -260,5 +243,14 @@ class SAC(Checkpointable):
             else float("nan"),
         }
 
-    def stop(self):
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def evaluate(self) -> dict:
+        # SAC's env loop is continuous-action and lives in the driver —
+        # the base's discrete eval runner does not apply
+        raise NotImplementedError(
+            "SAC evaluation rides episode_return_mean from training")
+
+    def cleanup(self):
         self.envs.close()

@@ -60,6 +60,10 @@ class CheckpointConfig:
     num_to_keep: int | None = None  # None = keep all
     checkpoint_score_attribute: str | None = None
     checkpoint_score_order: str = "max"  # "max" | "min"
+    # Tune class-trainable driver: ship a checkpoint every N iterations
+    # (reference: CheckpointConfig.checkpoint_frequency) — large states
+    # need not ride the session queue + disk every step
+    checkpoint_frequency: int = 1
 
 
 @dataclasses.dataclass

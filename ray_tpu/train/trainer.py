@@ -105,6 +105,10 @@ class RunConfig:
     storage_path: str | None = None
     failure_config: FailureConfig | None = None
     checkpoint_config: CheckpointConfig | None = None
+    # Tune stop criteria: {"metric": threshold} — a trial terminates when
+    # any named metric reaches its threshold (reference: air/config.py
+    # RunConfig.stop)
+    stop: dict | None = None
 
 
 @dataclasses.dataclass

@@ -10,15 +10,19 @@ from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (
+    Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
     randint,
     uniform,
 )
+from ray_tpu.tune.trainable import Trainable
 from ray_tpu.tune.tuner import (
     ResultGrid,
     TuneConfig,
@@ -32,8 +36,12 @@ __all__ = [
     "ASHAScheduler",
     "FIFOScheduler",
     "MedianStoppingRule",
+    "PB2",
     "PopulationBasedTraining",
     "ResultGrid",
+    "Searcher",
+    "TPESearcher",
+    "Trainable",
     "TuneConfig",
     "TuneResult",
     "Tuner",

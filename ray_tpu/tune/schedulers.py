@@ -226,3 +226,114 @@ class PopulationBasedTraining:
 
     def on_trial_complete(self, trial_id: str):
         self._scores.pop(trial_id, None)
+
+
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits (reference:
+    ray.tune.schedulers.pb2.PB2, tune/schedulers/pb2.py — Parker-Holder
+    et al. 2020): PBT's exploit step kept, but the EXPLORE step replaced
+    by a GP-bandit. Observed (config, reward-change) pairs fit a GP; the
+    new config maximizes UCB mean + kappa*std over `hyperparam_bounds`,
+    so the population searches the continuous box directly instead of
+    multiplying current values by fixed factors — which is what lets PB2
+    escape a bad initialization PBT would only crawl away from.
+    """
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: str | None = None, mode: str | None = None,
+                 perturbation_interval: int = 5,
+                 hyperparam_bounds: dict | None = None,
+                 quantile_fraction: float = 0.25,
+                 kappa: float = 1.5, seed: int | None = None):
+        super().__init__(time_attr=time_attr, metric=metric, mode=mode,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction, seed=seed)
+        self.bounds = dict(hyperparam_bounds or {})
+        self.kappa = kappa
+        # (normalized config vector, reward delta) observations
+        self._gp_data: list[tuple[list[float], float]] = []
+        self._last_obs: dict[str, tuple[float, float]] = {}  # t, value
+
+    # -- data collection --------------------------------------------------
+
+    def on_result(self, trial_id: str, result: dict):
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is not None and value is not None and self.bounds:
+            prev = self._last_obs.get(trial_id)
+            if prev is not None and t > prev[0]:
+                delta = (float(value) - prev[1]) / (t - prev[0])
+                if self.mode == "min":
+                    delta = -delta
+                vec = self._normalize(self._configs.get(trial_id, {}))
+                if vec is not None:
+                    self._gp_data.append((vec, delta))
+                    if len(self._gp_data) > 200:
+                        self._gp_data.pop(0)
+            self._last_obs[trial_id] = (float(t), float(value))
+        return super().on_result(trial_id, result)
+
+    def _normalize(self, config: dict) -> list[float] | None:
+        vec = []
+        for k, (lo, hi) in self.bounds.items():
+            v = config.get(k)
+            if not isinstance(v, (int, float)):
+                return None
+            vec.append((float(v) - lo) / max(hi - lo, 1e-12))
+        return vec
+
+    # -- GP-UCB explore ---------------------------------------------------
+
+    def _explore(self, config: dict) -> dict:
+        out = dict(config)
+        if not self.bounds:
+            return out
+        keys = list(self.bounds)
+        cand = self._candidates(config)
+        best = cand[0]
+        if len(self._gp_data) >= 4:
+            import numpy as np
+
+            X = np.array([d[0] for d in self._gp_data])
+            y = np.array([d[1] for d in self._gp_data])
+            y = (y - y.mean()) / (y.std() + 1e-9)
+            mu, sd = _gp_predict(X, y, np.array(cand))
+            best = cand[int(np.argmax(mu + self.kappa * sd))]
+        for i, k in enumerate(keys):
+            lo, hi = self.bounds[k]
+            v = lo + best[i] * (hi - lo)
+            cur = config.get(k)
+            out[k] = int(round(v)) if isinstance(cur, int) else v
+        return out
+
+    def _candidates(self, config: dict, n: int = 64) -> list[list[float]]:
+        d = len(self.bounds)
+        cand = [[self._rng.random() for _ in range(d)] for _ in range(n)]
+        base = self._normalize(config)
+        if base is not None:
+            # local jitters around the exploited config keep exploitation
+            # of a good region possible alongside global draws
+            for _ in range(n // 4):
+                cand.append([min(1.0, max(0.0,
+                             b + self._rng.gauss(0, 0.1))) for b in base])
+        return cand
+
+
+def _gp_predict(X, y, Xq, lengthscale: float = 0.3, noise: float = 1e-2):
+    """RBF-kernel GP posterior mean/std at query points (inputs already
+    normalized to [0,1]^d)."""
+    import numpy as np
+
+    def k(A, B):
+        d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / lengthscale ** 2)
+
+    K = k(X, X) + noise * np.eye(len(X))
+    L = np.linalg.cholesky(K)
+    alpha = np.linalg.solve(L.T, np.linalg.solve(L, y))
+    Ks = k(Xq, X)
+    mu = Ks @ alpha
+    v = np.linalg.solve(L, Ks.T)
+    var = np.clip(1.0 - (v ** 2).sum(0), 1e-9, None)
+    return mu, np.sqrt(var)

@@ -168,6 +168,7 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int | None = None
     scheduler: Any = None
+    search_alg: Any = None  # a tune.search.Searcher (e.g. TPESearcher)
     seed: int | None = None
     trial_resources: dict[str, float] | None = None
 
@@ -222,6 +223,77 @@ class ResultGrid:
             return rows
 
 
+# ------------------------------------------------- trainable adapters
+
+
+def _stop_met(stop: dict | None, result: dict) -> bool:
+    """Reference: ray.tune run(stop={...}) — stop when any named metric
+    reaches its threshold."""
+    if not stop:
+        return False
+    for k, v in stop.items():
+        r = result.get(k)
+        if r is not None and r >= v:
+            return True
+    return False
+
+
+def _class_trainable_fn(cls, ckpt_every: int = 1):
+    """Drive a Trainable subclass as a function trial: loop train(),
+    ship full state as the checkpoint each iteration, resume from the
+    session checkpoint on (re)start (reference:
+    tune/trainable/function_trainable.py wrapping vs class Trainable —
+    here the class API is bridged onto the session protocol). Stop
+    criteria are enforced driver-side in fit(), uniformly for every
+    trainable kind; the loop ends when the scheduler/driver stops the
+    session (report raises _StopTrial)."""
+
+    def fn(config):
+        t = cls(config)
+        ckpt = get_checkpoint()
+        if ckpt is not None:
+            t._restore_full_state(ckpt)
+        try:
+            while True:
+                result = t.train()
+                ship = t.iteration % max(1, ckpt_every) == 0
+                report(result,
+                       checkpoint=t._full_state() if ship else None)
+        finally:
+            t.stop()
+
+    return fn
+
+
+def _algo_config_fn(base_config, ckpt_every: int = 1):
+    """Drive an rllib AlgorithmConfig as a trial: each trial copies the
+    base config, overwrites the sampled hyperparams, builds the
+    algorithm (itself a Trainable), and loops train/checkpoint
+    (reference: Tuner("PPO", param_space=config) —
+    tune/registry + Algorithm-as-Trainable)."""
+    blob = cloudpickle.dumps(base_config)
+
+    def fn(config):
+        base = cloudpickle.loads(blob)
+        # validated update: a typo'd sweep key raises instead of
+        # silently running every trial on defaults
+        base.update_from_dict(config)
+        algo = base.build()
+        ckpt = get_checkpoint()
+        if ckpt is not None:
+            algo._restore_full_state(ckpt)
+        try:
+            while True:
+                result = algo.train()
+                ship = algo.iteration % max(1, ckpt_every) == 0
+                report(result,
+                       checkpoint=algo._full_state() if ship else None)
+        finally:
+            algo.stop()
+
+    return fn
+
+
 # ---------------------------------------------------------------- tuner
 
 
@@ -236,6 +308,7 @@ class Tuner:
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
         self._restored_trials: list[Trial] | None = None
+        self._restored_ckpts: dict[str, bytes] = {}
 
     # -- persistence -----------------------------------------------------
 
@@ -256,8 +329,9 @@ class Tuner:
     @classmethod
     def restore(cls, path: str, trainable: Callable) -> "Tuner":
         """Resume an interrupted experiment: finished trials keep their
-        recorded results, unfinished ones run again (reference:
-        Tuner.restore, tune/tuner.py)."""
+        recorded results; unfinished ones restart FROM THEIR LAST
+        CHECKPOINT when one was persisted (reference: Tuner.restore,
+        tune/tuner.py + trial checkpoint dirs)."""
         with open(os.path.join(path, "tuner_state.json")) as f:
             state = json.load(f)
         tuner = cls(trainable)
@@ -271,9 +345,42 @@ class Tuner:
             t.error = tj.get("error")
             if t.status in (Trial.PENDING, Trial.RUNNING):
                 t.status = Trial.PENDING  # rerun interrupted trials
+                ckpt_file = os.path.join(path, f"ckpt_{t.trial_id}.pkl")
+                if os.path.exists(ckpt_file):
+                    with open(ckpt_file, "rb") as cf:
+                        tuner._restored_ckpts[t.trial_id] = cf.read()
             trials.append(t)
         tuner._restored_trials = trials
         return tuner
+
+    def _persist_checkpoint(self, trial_id: str, blob: bytes):
+        d = self._exp_dir()
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, f".ckpt_{trial_id}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, os.path.join(d, f"ckpt_{trial_id}.pkl"))
+
+    # -- trainable resolution --------------------------------------------
+
+    def _resolve_trainable(self) -> tuple[Callable, dict]:
+        """Function trainables pass through; Trainable subclasses and
+        rllib AlgorithmConfig objects are adapted onto the session
+        protocol. AlgorithmConfig fields holding search markers
+        (grid_search / Domain) become the param space."""
+        from ray_tpu.tune.trainable import is_trainable_class
+
+        t = self._trainable
+        param_space = dict(self.param_space or {})
+        cc = getattr(self.run_config, "checkpoint_config", None)
+        ckpt_every = getattr(cc, "checkpoint_frequency", 1) if cc else 1
+        if is_trainable_class(t):
+            return _class_trainable_fn(t, ckpt_every), param_space
+        if hasattr(t, "build") and hasattr(t, "extract_param_space"):
+            algo_space = t.extract_param_space()
+            return _algo_config_fn(t, ckpt_every), \
+                {**algo_space, **param_space}
+        return t, param_space
 
     # -- fit -------------------------------------------------------------
 
@@ -284,14 +391,26 @@ class Tuner:
         scheduler = tc.scheduler or FIFOScheduler()
         if hasattr(scheduler, "set_objective") and tc.metric:
             scheduler.set_objective(tc.metric, tc.mode)
+        searcher = tc.search_alg
+        if searcher is not None and hasattr(searcher, "set_objective") \
+                and tc.metric:
+            searcher.set_objective(tc.metric, tc.mode)
+        trainable, param_space = self._resolve_trainable()
+        stop_criteria = getattr(self.run_config, "stop", None)
+        num_to_create = 0
         if self._restored_trials is not None:
             trials = self._restored_trials
+        elif searcher is not None:
+            # model-based search: configs are suggested one at a time as
+            # slots free, conditioned on completed results
+            trials = []
+            num_to_create = max(1, tc.num_samples)
         else:
-            variants = generate_variants(self.param_space, tc.num_samples,
+            variants = generate_variants(param_space, tc.num_samples,
                                          tc.seed)
             trials = [Trial(f"trial_{i:05d}", cfg)
                       for i, cfg in enumerate(variants)]
-        fn_blob = cloudpickle.dumps(self._trainable)
+        fn_blob = cloudpickle.dumps(trainable)
         res = dict(tc.trial_resources or {"CPU": 1.0})
         limit = tc.max_concurrent_trials or max(
             1, int(ray_tpu.cluster_resources().get("CPU", 1)))
@@ -304,11 +423,23 @@ class Tuner:
         running: list[Trial] = []
         ckpts: dict[str, bytes] = {}  # trial_id -> latest checkpoint blob
         self._save_state(trials)
-        while pending or running:
-            while pending and len(running) < limit:
-                t = pending.pop(0)
+        while pending or running or num_to_create > 0:
+            while (pending or num_to_create > 0) and len(running) < limit:
+                if pending:
+                    t = pending.pop(0)
+                else:
+                    tid = f"trial_{len(trials):05d}"
+                    cfg = searcher.suggest(tid)
+                    if cfg is None:
+                        num_to_create = 0
+                        break
+                    num_to_create -= 1
+                    t = Trial(tid, cfg)
+                    trials.append(t)
                 t.actor = actor_cls.options(
-                    max_concurrency=2).remote(t.trial_id, fn_blob, t.config)
+                    max_concurrency=2).remote(
+                        t.trial_id, fn_blob, t.config,
+                        self._restored_ckpts.get(t.trial_id))
                 t.status = Trial.RUNNING
                 running.append(t)
                 if hasattr(scheduler, "on_trial_add"):
@@ -322,22 +453,39 @@ class Tuner:
                     t.error = f"trial actor failed: {e}"
                     running.remove(t)
                     scheduler.on_trial_complete(t.trial_id)
+                    if searcher is not None:
+                        searcher.on_trial_complete(t.trial_id, None)
                     continue
                 if r.get("checkpoint"):
                     ckpts[t.trial_id] = r["checkpoint"]
+                    self._persist_checkpoint(t.trial_id, r["checkpoint"])
                 decision = CONTINUE
+                hit_stop = False
                 for m in r["results"]:
                     t.last_result = m
+                    if searcher is not None:
+                        searcher.on_trial_result(t.trial_id, m)
                     d = scheduler.on_result(t.trial_id, m)
                     if d == STOP:
                         decision = STOP
                     elif isinstance(d, tuple) and d[0] == "EXPLOIT":
                         decision = d
+                    if _stop_met(stop_criteria, m):
+                        # pin last_result at the stopping report: an
+                        # async trial may have raced a few iterations
+                        # past the criteria before we stop it
+                        hit_stop = True
+                        break
                 if r["error"]:
                     t.status = Trial.ERROR
                     t.error = r["error"]
-                elif r["done"]:
+                elif r["done"] or hit_stop:
                     t.status = Trial.TERMINATED
+                    if hit_stop and not r["done"]:
+                        try:
+                            ray_tpu.get(t.actor.stop.remote(), timeout=30)
+                        except Exception:  # noqa: BLE001
+                            pass
                 elif isinstance(decision, tuple):
                     # PBT exploit: restart this trial from the source
                     # trial's checkpoint with the mutated config
@@ -377,6 +525,8 @@ class Tuner:
                     t.actor = None
                     running.remove(t)
                     scheduler.on_trial_complete(t.trial_id)
+                    if searcher is not None:
+                        searcher.on_trial_complete(t.trial_id, t.last_result)
                     self._save_state(trials)
             time.sleep(0.02)
         self._save_state(trials)
