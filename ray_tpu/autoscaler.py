@@ -50,11 +50,16 @@ class FakeNodeProvider(NodeProvider):
         from ray_tpu.core.nodelet import Nodelet
 
         spec = self.node_types[node_type]
+        labels = dict(spec.get("labels", {}))
+        # the demand scheduler's cross-pass per-type accounting reads
+        # this label off registered nodes (and node_type off handles)
+        labels.setdefault("ray_tpu.node_type", node_type)
         nl = Nodelet(self.head_address, dict(spec.get("resources", {})),
-                     labels=dict(spec.get("labels", {})),
+                     labels=labels,
                      session_dir=self.session_dir,
                      store_capacity=spec.get("store_capacity",
                                              64 * 1024 * 1024)).start()
+        nl.node_type = node_type
         self._nodes.append(nl)
         return nl
 
@@ -70,6 +75,97 @@ class FakeNodeProvider(NodeProvider):
 
     def node_id(self, handle) -> bytes:
         return handle.node_id
+
+
+def collect_demand_bundles(alive_nodes: list[dict],
+                           pgs: list[dict]) -> list[dict]:
+    """Demand SHAPES the cluster cannot currently place: each node's
+    aggregate queued-task demand plus every bundle of a PENDING
+    placement group (reference: load_metrics resource_load_by_shape +
+    pending PG bundles feeding resource_demand_scheduler.py:102)."""
+    bundles: list[dict] = []
+    for n in alive_nodes:
+        qd = {r: q for r, q in n.get("queued_demand", {}).items() if q > 0}
+        if qd:
+            bundles.append(qd)
+    for g in pgs:
+        if g.get("state") == "PENDING":
+            bundles.extend(dict(b) for b in g.get("bundles", []))
+    return bundles
+
+
+class ResourceDemandScheduler:
+    """Bin-pack unplaceable demand onto node TYPES (reference:
+    autoscaler/_private/resource_demand_scheduler.py:102
+    get_nodes_to_launch): first fill existing headroom, then open the
+    cheapest node type that fits each remaining bundle (cost = the
+    type's optional "cost" key; ties go to the least total capacity, so
+    small demands don't launch big boxes)."""
+
+    def __init__(self, node_types: dict[str, dict],
+                 max_workers: int = 4):
+        self.node_types = node_types
+        self.max_workers = max_workers
+
+    @staticmethod
+    def _fits(avail: dict, bundle: dict) -> bool:
+        return all(avail.get(r, 0.0) + 1e-9 >= q for r, q in bundle.items())
+
+    @staticmethod
+    def _deduct(avail: dict, bundle: dict):
+        for r, q in bundle.items():
+            avail[r] = avail.get(r, 0.0) - q
+
+    def get_nodes_to_launch(self, demands: list[dict],
+                            existing_headroom: list[dict],
+                            existing_count: int,
+                            existing_by_type: dict[str, int] | None = None
+                            ) -> dict[str, int]:
+        """demands: resource bundles with no current placement.
+        existing_headroom: available-resources dicts of alive nodes.
+        existing_by_type: running/booting node counts per type, so the
+        per-type max_workers cap holds across reconcile passes (not just
+        within one). Returns {node_type: count} to launch."""
+        existing_by_type = existing_by_type or {}
+        bins = [dict(h) for h in existing_headroom]
+        virtual: list[tuple[str, dict]] = []  # (type, remaining)
+        to_launch: dict[str, int] = {}
+        budget = max(0, self.max_workers - existing_count)
+        # largest-first packs tight (first-fit-decreasing)
+        for bundle in sorted(demands,
+                             key=lambda b: -sum(b.values())):
+            placed = False
+            for b in bins:
+                if self._fits(b, bundle):
+                    self._deduct(b, bundle)
+                    placed = True
+                    break
+            if placed:
+                continue
+            for _, rem in virtual:
+                if self._fits(rem, bundle):
+                    self._deduct(rem, bundle)
+                    placed = True
+                    break
+            if placed or sum(to_launch.values()) >= budget:
+                continue
+            candidates = [
+                (spec.get("cost", 1.0),
+                 sum(spec.get("resources", {}).values()), name)
+                for name, spec in self.node_types.items()
+                if self._fits(dict(spec.get("resources", {})), bundle)
+                and to_launch.get(name, 0) +
+                existing_by_type.get(name, 0) <
+                spec.get("max_workers", self.max_workers)
+            ]
+            if not candidates:
+                continue  # infeasible on every type: leave for the user
+            _, _, best = min(candidates)
+            rem = dict(self.node_types[best].get("resources", {}))
+            self._deduct(rem, bundle)
+            virtual.append((best, rem))
+            to_launch[best] = to_launch.get(best, 0) + 1
+        return to_launch
 
 
 def compute_demand(alive_nodes: list[dict], pgs: list[dict]) -> bool:
@@ -103,6 +199,10 @@ class AutoscalerConfig:
     idle_timeout_s: float = 30.0
     poll_interval_s: float = 1.0
     upscaling_speed: int = 1  # nodes added per decision
+    # heterogeneous mode: {type: {"resources": {...}, "cost": c,
+    # "max_workers": m}} — demand bundles are bin-packed onto types by
+    # the ResourceDemandScheduler instead of launching `node_type`
+    node_types: dict | None = None
 
 
 class StandardAutoscaler:
@@ -144,6 +244,41 @@ class StandardAutoscaler:
             return
         alive = [n for n in view if n["alive"]]
         managed = self.provider.non_terminated_nodes()
+
+        if cfg.node_types:
+            # heterogeneous path: pack unplaceable shapes onto types
+            demands = collect_demand_bundles(alive, pgs)
+            if demands:
+                sched = ResourceDemandScheduler(cfg.node_types,
+                                                cfg.max_workers)
+                # per-type counts: registered nodes by label, launched-
+                # but-not-yet-heartbeating ones by provider handle; take
+                # the max per type so a node visible through both views
+                # counts once
+                by_label: dict[str, int] = {}
+                for n in alive:
+                    t = n.get("labels", {}).get("ray_tpu.node_type")
+                    if t:
+                        by_label[t] = by_label.get(t, 0) + 1
+                by_handle: dict[str, int] = {}
+                for h in managed:
+                    t = (h.get("node_type") if isinstance(h, dict)
+                         else getattr(h, "node_type", None))
+                    if t:
+                        by_handle[t] = by_handle.get(t, 0) + 1
+                by_type = {t: max(by_label.get(t, 0), by_handle.get(t, 0))
+                           for t in {*by_label, *by_handle}}
+                plan = sched.get_nodes_to_launch(
+                    demands, [n.get("available", {}) for n in alive],
+                    len(managed), existing_by_type=by_type)
+                for node_type, count in plan.items():
+                    for _ in range(count):
+                        self.provider.create_node(node_type)
+                        self.num_launches += 1
+                if plan:
+                    return
+            # fall through to reconcile_down timing
+            return
 
         want_up = compute_demand(alive, pgs)
         if want_up and len(managed) < cfg.max_workers:

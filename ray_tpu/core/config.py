@@ -1,7 +1,7 @@
 """Central config/flag registry.
 
 Reference parity: the RAY_CONFIG macro registry
-(src/ray/common/ray_config_def.h:18 — typed defaults,每 flag
+(src/ray/common/ray_config_def.h:18 — typed defaults, every flag
 overridable via RAY_<name> env vars, serialized head->nodes). Here:
 typed defaults overridable via RAY_TPU_<NAME> env vars; `snapshot()`
 serializes the effective config so a head can hand it to joining
@@ -31,6 +31,9 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     "PRESTART_WORKERS": (int, 0, "warm workers spawned at nodelet start"),
     "WORKER_START_TIMEOUT_S": (float, 60.0, "worker boot deadline"),
     "MAX_SPILLBACKS": (int, 4, "scheduling hops before running anywhere"),
+    "LABEL_INFEASIBLE_TIMEOUT_S": (float, 30.0,
+                                   "fail a hard-label task no alive node "
+                                   "matches after this"),
     "PULL_CHUNK_BYTES": (int, 4 * 1024 * 1024,
                          "node-to-node object transfer chunk"),
     # --- memory monitor / OOM killing (reference: ray_config_def.h:65

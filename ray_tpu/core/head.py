@@ -72,6 +72,7 @@ class Head:
         # long-poll subscriber mailboxes: sub_id -> {topics, queue, cond}
         self._poll_subs: dict = {}
         self._queue_lens: dict[bytes, int] = {}  # pending tasks per node
+        self._queued_demands: dict[bytes, dict] = {}  # queued shapes/node
         self._stopped = threading.Event()
         # storage writes are queued IN LOCK ORDER and drained by one
         # writer thread: disk order then matches memory order without
@@ -208,6 +209,7 @@ class Head:
                 if "available" in msg:
                     self._available[nid] = msg["available"]
                     self._queue_lens[nid] = msg.get("queue_len", 0)
+                    self._queued_demands[nid] = msg.get("queued_demand", {})
                 self._nodes[nid].alive = True
 
     def _h_cluster_view(self, msg, frames):
@@ -223,6 +225,8 @@ class Head:
                         "store_name": n.store_name,
                         "alive": n.alive,
                         "queue_len": self._queue_lens.get(n.node_id, 0),
+                        "queued_demand": self._queued_demands.get(
+                            n.node_id, {}),
                     }
                     for n in self._nodes.values()
                 ]
@@ -329,25 +333,41 @@ class Head:
                 if nid is not None and nid in self._nodes and self._nodes[nid].alive:
                     return self._nodes[nid]
                 return None
-            best, best_score = None, None
-            for n in self._nodes.values():
-                if not n.alive or (exclude and n.node_id in exclude):
-                    continue
-                if label_selector and any(n.labels.get(k) != v
-                                          for k, v in label_selector.items()):
-                    continue
-                avail = self._available.get(n.node_id, {})
-                total = n.resources
-                if any(total.get(r, 0.0) < q for r, q in resources.items()):
-                    continue  # infeasible on this node
-                if require_avail and any(avail.get(r, 0.0) < q
-                                         for r, q in resources.items()):
-                    continue
-                free = sum(min(avail.get(r, 0.0) / q, 10.0)
-                           for r, q in resources.items() if q) if resources else \
-                    sum(avail.values())
-                if best_score is None or free > best_score:
-                    best, best_score = n, free
+            from ray_tpu.util.scheduling_strategies import (
+                split_soft_selector,
+            )
+
+            sel, soft_sel = split_soft_selector(label_selector)
+
+            def scan(selector):
+                best, best_score = None, None
+                for n in self._nodes.values():
+                    if not n.alive or (exclude and n.node_id in exclude):
+                        continue
+                    if selector and any(n.labels.get(k) != v
+                                        for k, v in selector.items()):
+                        continue
+                    avail = self._available.get(n.node_id, {})
+                    total = n.resources
+                    if any(total.get(r, 0.0) < q
+                           for r, q in resources.items()):
+                        continue  # infeasible on this node
+                    if require_avail and any(avail.get(r, 0.0) < q
+                                             for r, q in resources.items()):
+                        continue
+                    free = sum(min(avail.get(r, 0.0) / q, 10.0)
+                               for r, q in resources.items() if q) \
+                        if resources else sum(avail.values())
+                    if best_score is None or free > best_score:
+                        best, best_score = n, free
+                return best
+
+            best = scan(sel)
+            if best is None and soft_sel and sel:
+                # soft affinity: the preferred node is gone — fall back
+                # to any feasible node (reference:
+                # scheduling_strategies.py soft semantics)
+                best = scan({})
             if best is not None:
                 avail = self._available.get(best.node_id)
                 if avail is not None:

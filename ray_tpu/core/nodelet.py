@@ -321,7 +321,8 @@ class Nodelet:
                     self._lease_demand.pop(o, None)
                 qlen = len(self._queue) + sum(
                     c for _, c in self._lease_demand.values())
-            snapshot = (avail, qlen)
+                qdemand = dict(self._queued_demand)
+            snapshot = (avail, qlen, qdemand)
             beats_since_full += 1
             msg = {"node_id": self.node_id}
             carries_payload = (snapshot != last_sent
@@ -329,6 +330,11 @@ class Nodelet:
             if carries_payload:
                 msg["available"] = avail
                 msg["queue_len"] = qlen
+                # demand SHAPES (aggregate over queued tasks) — the v1
+                # autoscaler's demand scheduler bin-packs these onto
+                # node types (reference: resource_demand_scheduler.py
+                # reads load_metrics resource_load_by_shape)
+                msg["queued_demand"] = qdemand
             try:
                 self.client.send_oneway(self.head_address, "heartbeat", msg)
             except Exception:
@@ -809,7 +815,28 @@ class Nodelet:
                 # PG tasks were routed here by the owner via pg_bundle_node;
                 # run them against the reservation.
                 return "local"
-            fits_total = all(self.resources.get(r, 0.0) >= q for r, q in req.items())
+        from ray_tpu.util.scheduling_strategies import (
+            labels_match,
+            split_soft_selector,
+        )
+
+        sel, soft_sel = split_soft_selector(spec.label_selector)
+        if sel and not labels_match(self.labels, sel):
+            # label-constrained task on a non-matching node: route to a
+            # matching node (reference: label scheduling / node affinity,
+            # node_affinity_scheduling_policy.h:29). Hard selectors wait
+            # when no match exists; soft selectors fall back to the
+            # normal placement path below.
+            best = self._best_fit_node(req, self._cluster_view_cached(),
+                                       exclude_node_id=self.node_id,
+                                       selector=sel)
+            if best is not None:
+                return best["address"]
+            if not soft_sel:
+                return None  # infeasible-wait: dispatch guard holds it
+        with self._lock:
+            fits_total = all(self.resources.get(r, 0.0) >= q
+                             for r, q in req.items())
             fits_now = all(
                 self._available.get(r, 0.0) -
                 self._queued_demand.get(r, 0.0) >= q
@@ -818,9 +845,12 @@ class Nodelet:
         if fits_now or (fits_total and queue_len < 2) or \
                 spec.spillback_count >= cfg.get("MAX_SPILLBACKS"):
             return "local" if fits_total or spec.placement_group else None
-        # look for a better node
+        # look for a better node — honoring the task's selector, so a
+        # hard-label task on a matching-but-busy node never bounces to a
+        # non-matching one
         best = self._best_fit_node(req, self._cluster_view_cached(),
-                                   exclude_node_id=self.node_id)
+                                   exclude_node_id=self.node_id,
+                                   selector=sel or None)
         if best is not None:
             return best["address"]
         return "local" if fits_total else None
@@ -848,12 +878,18 @@ class Nodelet:
                 self._queued_demand[r] = v
 
     @staticmethod
-    def _best_fit_node(req: dict, view: list, exclude_node_id=None):
+    def _best_fit_node(req: dict, view: list, exclude_node_id=None,
+                       selector: dict | None = None):
         """Feasible node with the most free capacity (shared by initial
-        placement and aged-task respill)."""
+        placement and aged-task respill); `selector` restricts to
+        label-matching nodes."""
+        from ray_tpu.util.scheduling_strategies import labels_match
+
         best, best_free = None, None
         for n in view:
             if n["node_id"] == exclude_node_id or not n.get("alive"):
+                continue
+            if selector and not labels_match(n.get("labels", {}), selector):
                 continue
             total, avail = n["resources"], n["available"]
             if any(total.get(r, 0.0) < q for r, q in req.items()):
@@ -879,9 +915,12 @@ class Nodelet:
             spec.task_id, time.monotonic())
         if waited < 0.5:
             return None
+        from ray_tpu.util.scheduling_strategies import split_soft_selector
+
+        sel, _ = split_soft_selector(spec.label_selector)
         best = self._best_fit_node(
             spec.resources, self._cluster_view,  # refreshed by dispatch
-            exclude_node_id=self.node_id)
+            exclude_node_id=self.node_id, selector=sel or None)
         return best["address"] if best else None
 
     def _send_respill(self, spec: TaskSpec, target: str):
@@ -966,8 +1005,10 @@ class Nodelet:
                 # can respill to newly-added capacity; this blocks only
                 # the dispatch thread, never heartbeats
                 self._cluster_view_cached()
+            rotated = 0  # label-blocked tasks rotated this pass
             while True:
                 reject = None
+                reject_msg = None
                 respill = None
                 with self._lock:
                     if not self._queue:
@@ -985,13 +1026,55 @@ class Nodelet:
                             self._enqueue_time.pop(spec.task_id, None)
                             reject = spec
                     if reject is None:
-                        if not self._can_run(req):
+                        from ray_tpu.util.scheduling_strategies import (
+                            labels_match as _lm,
+                            split_soft_selector as _sss,
+                        )
+
+                        sel, soft_sel = _sss(spec.label_selector)
+                        label_blocked = bool(sel) and \
+                            not _lm(self.labels, sel)
+                        if label_blocked or not self._can_run(req):
                             respill = self._maybe_respill_locked(spec)
                             if respill is None:
-                                break
-                            self._queue.popleft()
-                            self._add_queued_demand(spec, -1)
-                            self._enqueue_time.pop(spec.task_id, None)
+                                if label_blocked and not soft_sel:
+                                    # hard affinity with no matching
+                                    # node: never park at the queue head
+                                    # (it would starve every task behind
+                                    # it) — rotate to the back, and fail
+                                    # it once it has waited out the
+                                    # timeout (reference: hard-affinity
+                                    # placement fails when the node is
+                                    # gone)
+                                    waited = time.monotonic() - \
+                                        self._enqueue_time.get(
+                                            spec.task_id,
+                                            time.monotonic())
+                                    self._queue.popleft()
+                                    if waited > cfg.get(
+                                            "LABEL_INFEASIBLE_TIMEOUT_S"):
+                                        self._add_queued_demand(spec, -1)
+                                        self._enqueue_time.pop(
+                                            spec.task_id, None)
+                                        reject = spec
+                                        reject_msg = (
+                                            "no alive node matches hard "
+                                            f"label selector {sel} after "
+                                            "LABEL_INFEASIBLE_TIMEOUT_S")
+                                    else:
+                                        self._queue.append(spec)
+                                        rotated += 1
+                                        if rotated >= len(self._queue):
+                                            break  # full lap: all blocked
+                                        continue
+                                elif not self._can_run(req):
+                                    break
+                                # soft selector, no match anywhere, local
+                                # resources free: fall back to local run
+                            else:
+                                self._queue.popleft()
+                                self._add_queued_demand(spec, -1)
+                                self._enqueue_time.pop(spec.task_id, None)
                     if reject is None and respill is None:
                         needs_tpu = spec.resources.get("TPU", 0) > 0
                         from ray_tpu.core import runtime_env as _rtenv
@@ -1046,6 +1129,7 @@ class Nodelet:
                 if reject is not None:
                     self._fail_task(
                         reject,
+                        reject_msg or
                         f"task resources {reject.resources} can never fit "
                         f"its placement-group bundle reservation")
                     continue

@@ -72,6 +72,16 @@ def cmd_start(args):
                       labels=json.loads(args.labels or "{}"),
                       session_dir=session_dir).start()
     print(f"nodelet started at {nodelet.address} with {res}")
+    if getattr(args, "node_info_file", None):
+        # machine-readable handle for the cluster launcher / autoscaler
+        # provider (reference: the node's metadata in the GCS node table)
+        tmp = args.node_info_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"node_id_hex": nodelet.node_id.hex(),
+                       "address": nodelet.address,
+                       "head_address": head_address,
+                       "pid": os.getpid()}, f)
+        os.replace(tmp, args.node_info_file)
     if args.address_file:
         tmp = args.address_file + ".tmp"
         with open(tmp, "w") as f:
@@ -174,6 +184,44 @@ def cmd_stop(args):
     return 0
 
 
+def cmd_up(args):
+    from ray_tpu import launcher
+
+    cfg = launcher.load_cluster_config(args.config_file)
+    state = launcher.up(cfg, state_dir=args.state_dir)
+    print(f"cluster {cfg['cluster_name']!r} up: "
+          f"head at {state['head']['address']}, "
+          f"{len(state['workers'])} workers")
+    print(f"connect with: ray_tpu.init(address="
+          f"{state['head']['address']!r})")
+    return 0
+
+
+def cmd_down(args):
+    from ray_tpu import launcher
+
+    state = launcher.down(args.cluster_name, state_dir=args.state_dir)
+    n = len(state.get("workers", [])) + (1 if state.get("head") else 0)
+    print(f"cluster {args.cluster_name!r} down ({n} nodes terminated)")
+    return 0
+
+
+def cmd_exec(args):
+    from ray_tpu import launcher
+
+    cmd = " ".join(args.command)
+    if cmd.startswith("-- "):
+        cmd = cmd[3:]
+    return launcher.exec_on_cluster(args.cluster_name, cmd,
+                                    state_dir=args.state_dir)
+
+
+def cmd_attach(args):
+    from ray_tpu import launcher
+
+    return launcher.attach(args.cluster_name, state_dir=args.state_dir)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="ray_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -188,6 +236,7 @@ def main(argv=None):
     p.add_argument("--labels")
     p.add_argument("--session-dir")
     p.add_argument("--address-file")
+    p.add_argument("--node-info-file")
     p.add_argument("--block", action="store_true")
     p.set_defaults(fn=cmd_start)
 
@@ -229,6 +278,29 @@ def main(argv=None):
     p.add_argument("--address", required=True)
     p.add_argument("--id")
     p.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser("up", help="boot a cluster from a YAML config")
+    p.add_argument("config_file")
+    p.add_argument("--state-dir")
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down", help="terminate a launched cluster")
+    p.add_argument("cluster_name")
+    p.add_argument("--state-dir")
+    p.set_defaults(fn=cmd_down)
+
+    p = sub.add_parser("exec", help="run a command with the cluster "
+                                    "address exported")
+    p.add_argument("cluster_name")
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    p.add_argument("--state-dir")
+    p.set_defaults(fn=cmd_exec)
+
+    p = sub.add_parser("attach", help="interactive shell against the "
+                                      "cluster")
+    p.add_argument("cluster_name")
+    p.add_argument("--state-dir")
+    p.set_defaults(fn=cmd_attach)
 
     args = ap.parse_args(argv)
     return args.fn(args)

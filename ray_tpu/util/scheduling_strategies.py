@@ -13,6 +13,22 @@ from __future__ import annotations
 
 import dataclasses
 
+# Marker key carried inside a label selector: "prefer nodes matching the
+# other keys, but fall back anywhere if none exists". Schedulers pop it
+# before matching (nodelet._place / head._pick_node).
+SOFT_AFFINITY_LABEL = "ray.io/soft-node-affinity"
+
+
+def split_soft_selector(selector: dict | None) -> tuple[dict, bool]:
+    """(selector-without-marker, is_soft)."""
+    sel = dict(selector or {})
+    soft = sel.pop(SOFT_AFFINITY_LABEL, None) is not None
+    return sel, soft
+
+
+def labels_match(labels: dict, selector: dict) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())
+
 
 @dataclasses.dataclass
 class NodeAffinitySchedulingStrategy:
@@ -24,7 +40,10 @@ class NodeAffinitySchedulingStrategy:
     soft: bool = False
 
     def to_label_selector(self) -> dict[str, str]:
-        return {"ray.io/node-id": self.node_id}
+        sel = {"ray.io/node-id": self.node_id}
+        if self.soft:
+            sel[SOFT_AFFINITY_LABEL] = "1"
+        return sel
 
 
 @dataclasses.dataclass

@@ -194,8 +194,23 @@ def summarize(address: str | None = None) -> dict:
 
 def serve_status(address: str | None = None) -> dict:
     """Serve apps + per-proxy request metrics (reference: `ray serve
-    status` / the serve state surface). Requires an initialized runtime
-    (the serve control plane lives in actors, not the head tables)."""
+    status` / the serve state surface). The serve control plane lives in
+    actors, so this needs a runtime: with `address` given it connects to
+    that head when no runtime exists, and refuses to silently answer
+    from a DIFFERENT cluster than the one asked about."""
+    import ray_tpu
     from ray_tpu import serve
 
+    if address is not None:
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address)
+        else:
+            from ray_tpu.core.api import _global_runtime
+
+            current = getattr(_global_runtime(), "head_address", None)
+            if current is not None and current != address:
+                raise ValueError(
+                    f"runtime is connected to {current!r}, not "
+                    f"{address!r}; serve status reflects the connected "
+                    "cluster")
     return serve.status()
