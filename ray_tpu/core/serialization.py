@@ -24,6 +24,19 @@ import cloudpickle
 _MAGIC = 0x52545053  # "RTPS"
 _ALIGN = 64
 
+# Per-process payload accounting (reference role: object-store metrics).
+# pickle_bytes counts bytes that went THROUGH the pickle stream;
+# buffer_bytes counts out-of-band payload that bypassed it. The data
+# layer's zero-copy claim is auditable as: big numeric blocks move with
+# buffer_bytes ≈ payload and pickle_bytes ≈ envelope-only.
+STATS = {"pickle_bytes": 0, "buffer_bytes": 0,
+         "serialize_calls": 0, "deserialize_calls": 0}
+
+
+def reset_stats():
+    for k in STATS:
+        STATS[k] = 0
+
 
 def _pad(n: int) -> int:
     return (n + _ALIGN - 1) & ~(_ALIGN - 1)
@@ -66,6 +79,9 @@ def serialize(obj: Any) -> tuple[bytes, list[memoryview], int]:
         payload = cloudpickle.dumps(obj, protocol=5,
                                     buffer_callback=buffers.append)
     views = [b.raw() for b in buffers]
+    STATS["serialize_calls"] += 1
+    STATS["pickle_bytes"] += len(payload)
+    STATS["buffer_bytes"] += sum(v.nbytes for v in views)
     head = struct.pack("<II", _MAGIC, len(views))
     head += struct.pack("<Q", len(payload))
     for v in views:
@@ -122,6 +138,9 @@ def _deserialize(buf: memoryview) -> tuple[Any, int]:
     for bl in blens:
         oob.append(buf[off:off + bl])
         off = _pad(off + bl)
+    STATS["deserialize_calls"] += 1
+    STATS["pickle_bytes"] += plen
+    STATS["buffer_bytes"] += sum(blens)
     return pickle.loads(pickle_bytes, buffers=oob), len(oob)
 
 

@@ -114,6 +114,12 @@ class Dataset:
             for t in self._stream_thunks]
         return _StreamingInput(gens)
 
+    def _is_plain_blocks(self) -> bool:
+        """True when _block_refs already IS the dataset: no pending
+        ops, no actor map stage, no streaming source."""
+        return not self._ops and self._stream_thunks is None and \
+            getattr(self, "_actor_stage", None) is None
+
     def _require_eager(self, what: str):
         if self._stream_thunks is not None:
             raise ValueError(
@@ -189,19 +195,34 @@ class Dataset:
                     compute: str | None = None, num_actors: int = 2
                     ) -> "Dataset":
         def apply(block: list) -> list:
-            if not block:
+            from ray_tpu.data.block import (
+                block_num_rows,
+                is_columnar,
+                to_batch,
+                to_rows,
+            )
+
+            if not block_num_rows(block):
                 return block
             if batch_format == "numpy":
-                out = fn(rows_to_batch(block))
-                return batch_to_rows(out)
+                # columnar in, columnar out: a dict-of-numpy (or bare
+                # ndarray) result STAYS columnar — the block moves
+                # through the store with out-of-band buffers and the
+                # next numpy stage consumes it without row conversion
+                # (reference: Arrow blocks flowing between map stages)
+                out = fn(to_batch(block))
+                if is_columnar(out):
+                    return out
+                return batch_to_rows(out) if isinstance(out, dict) \
+                    else list(out)
             if batch_format == "pyarrow":
                 import pyarrow as pa
 
                 rows = [r if isinstance(r, dict) else {"value": r}
-                        for r in block]
+                        for r in to_rows(block)]
                 out = fn(pa.Table.from_pylist(rows))
                 return out.to_pylist()
-            out = fn(block)
+            out = fn(to_rows(block))
             return list(out)
 
         if compute == "actors":
@@ -212,8 +233,88 @@ class Dataset:
         return self._with(_MapBatchesOp(apply))
 
     def repartition(self, num_blocks: int) -> "Dataset":
-        rows = self.take_all()
+        """Rebalance into `num_blocks` blocks (reference:
+        Dataset.repartition). Columnar outputs stay columnar — the
+        blocks are concatenated and re-split as column views, never as
+        rows."""
+        import ray_tpu
+
+        from ray_tpu.data.block import (
+            columnar_kinds_compatible,
+            concat_batches,
+            is_columnar,
+            split_columnar,
+        )
+
+        blocks = list(self._iter_output_blocks())
+        if blocks and all(is_columnar(b) for b in blocks) and \
+                columnar_kinds_compatible(blocks):
+            whole = concat_batches(blocks)
+            return Dataset([ray_tpu.put(b)
+                            for b in split_columnar(whole, num_blocks)])
+        rows = [r for b in blocks for r in _to_rows(b)]
         return Dataset.from_items(rows, num_blocks)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Concatenate datasets block-wise (reference: Dataset.union —
+        no driver materialization of rows; pending plans execute into
+        blocks first)."""
+        refs = []
+        for ds in (self, *others):
+            if not ds._is_plain_blocks():
+                ds = ds.materialize()
+            refs.extend(ds._block_refs)
+        return Dataset(refs)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Merge two datasets column-wise, row for row (reference:
+        Dataset.zip — equal row counts required; duplicate column names
+        from the right side get a "_1" suffix; non-dict rows pair into
+        tuples). Runs as one remote zip task per left block, with the
+        right side re-sliced to align — columnar blocks merge as column
+        dicts without row conversion."""
+        import ray_tpu
+
+        left = self if self._is_plain_blocks() else self.materialize()
+        right = other if other._is_plain_blocks() else other.materialize()
+
+        @ray_tpu.remote(num_cpus=1)
+        def _nrows(b):
+            from ray_tpu.data.block import block_num_rows
+
+            return block_num_rows(b)
+
+        lc = ray_tpu.get([_nrows.remote(r) for r in left._block_refs],
+                         timeout=600)
+        rc = ray_tpu.get([_nrows.remote(r) for r in right._block_refs],
+                         timeout=600)
+        if sum(lc) != sum(rc):
+            raise ValueError(
+                f"zip: datasets must have equal row counts "
+                f"({sum(lc)} vs {sum(rc)})")
+
+        # right-block spans covering each left block's row range
+        r_starts = []
+        acc = 0
+        for c in rc:
+            r_starts.append(acc)
+            acc += c
+        out_refs = []
+        pos = 0
+        for li, lref in enumerate(left._block_refs):
+            lo, hi = pos, pos + lc[li]
+            pos = hi
+            spans, rrefs = [], []
+            for ri, (rs, c) in enumerate(zip(r_starts, rc)):
+                re_ = rs + c
+                if re_ <= lo or rs >= hi or c == 0:
+                    continue
+                spans.append((len(rrefs), max(lo, rs) - rs,
+                              min(hi, re_) - rs))
+                rrefs.append(right._block_refs[ri])
+            out_refs.append(ray_tpu.remote(num_cpus=1)(
+                _zip_blocks_fn).remote(lref, spans, *rrefs))
+        return Dataset(out_refs)
 
     # ---------------------------------------------------------- all-to-all
 
@@ -226,7 +327,8 @@ class Dataset:
         map stage is per-block, so a per-block limit would leak n rows
         PER BLOCK into the shuffle instead of n total."""
         if any(isinstance(o, Limit) for o in self._ops) or \
-                self._stream_thunks is not None:
+                self._stream_thunks is not None or \
+                getattr(self, "_actor_stage", None) is not None:
             rows = self.take_all()
             ds = Dataset.from_items(rows, max(1, len(self._block_refs)))
             return ds._block_refs, []
@@ -361,19 +463,34 @@ class Dataset:
 
     # ------------------------------------------------------------ consume
 
-    def iter_rows(self) -> Iterator:
+    def _iter_output_blocks(self) -> Iterator:
+        """Executed blocks in their native format (rows or columnar),
+        sliced to the plan's global Limit."""
         import ray_tpu
+
+        from ray_tpu.data.block import block_num_rows, slice_block
 
         # a plan-suffix Limit caps the GLOBAL row count: stop the stream
         # (and its in-flight work) as soon as it is met
         cap = LogicalPlan(self._ops).global_limit()
         n = 0
         for ref in self._execute():
-            for row in ray_tpu.get(ref, timeout=600):
-                yield row
-                n += 1
-                if cap is not None and n >= cap:
-                    return
+            block = ray_tpu.get(ref, timeout=600)
+            rows = block_num_rows(block)
+            if cap is not None and n + rows > cap:
+                block = slice_block(block, 0, cap - n)
+                rows = cap - n
+            if rows:
+                n += rows
+                yield block
+            if cap is not None and n >= cap:
+                return
+
+    def iter_rows(self) -> Iterator:
+        from ray_tpu.data.block import to_rows
+
+        for block in self._iter_output_blocks():
+            yield from to_rows(block)
 
     def explain(self) -> str:
         """The optimized logical plan (reference: Dataset plan repr)."""
@@ -382,10 +499,39 @@ class Dataset:
     def iter_batches(self, *, batch_size: int = 256,
                      batch_format: str = "numpy") -> Iterator:
         """Re-batch across block boundaries (reference:
-        data/_internal/iterator/)."""
+        data/_internal/iterator/). The numpy path is COLUMNAR end to
+        end: blocks are consumed as dict-of-numpy batches and re-cut by
+        slicing/concatenating column arrays — rows are never
+        materialized, and a batch fully inside one block is a numpy
+        VIEW of the shm-backed columns (zero copy)."""
+        if batch_format == "numpy":
+            from ray_tpu.data.block import (
+                block_num_rows,
+                concat_batches,
+                slice_block,
+                to_batch,
+            )
+
+            pieces: list = []
+            have = 0
+            for block in self._iter_output_blocks():
+                batch = to_batch(block)
+                start = 0
+                n = block_num_rows(batch)
+                while n - start >= batch_size - have:
+                    take = batch_size - have
+                    pieces.append(slice_block(batch, start, start + take))
+                    start += take
+                    yield concat_batches(pieces)
+                    pieces, have = [], 0
+                if start < n:
+                    pieces.append(slice_block(batch, start, n))
+                    have += n - start
+            if have:
+                yield concat_batches(pieces)
+            return
+
         def fmt(rows):
-            if batch_format == "numpy":
-                return rows_to_batch(rows)
             if batch_format == "pyarrow":
                 import pyarrow as pa
 
@@ -469,9 +615,11 @@ class Dataset:
     def count(self) -> int:
         import ray_tpu
 
+        from ray_tpu.data.block import block_num_rows
+
         if not self._ops and getattr(self, "_actor_stage", None) is None \
                 and self._stream_thunks is None:
-            return sum(len(b) for b in
+            return sum(block_num_rows(b) for b in
                        ray_tpu.get(list(self._block_refs), timeout=600))
         return sum(1 for _ in self.iter_rows())
 
@@ -501,7 +649,7 @@ class Dataset:
             block = ray_tpu.get(ref, timeout=600)
             path = _os.path.join(directory, f"part-{i:05d}.parquet")
             rows = [r if isinstance(r, dict) else {"value": r}
-                    for r in block]
+                    for r in _to_rows(block)]
             pq.write_table(pa.Table.from_pylist(rows), path)
             paths.append(path)
         return paths
@@ -522,8 +670,11 @@ class Dataset:
             block = ray_tpu.get(ref, timeout=600)
             path = _os.path.join(directory, f"part-{i:05d}.jsonl")
             with open(path, "w") as f:
-                for row in block:
-                    f.write(json.dumps(row, default=str) + "\n")
+                for row in _to_rows(block):
+                    # numpy scalars (columnar rows) serialize as numbers
+                    f.write(json.dumps(
+                        row, default=lambda o: o.item()
+                        if hasattr(o, "item") else str(o)) + "\n")
             paths.append(path)
         return paths
 
@@ -635,6 +786,45 @@ class GroupedData:
         return ds.flat_map(lambda r: r if isinstance(r, list) else [r])
 
 
+def _to_rows(block):
+    from ray_tpu.data.block import to_rows
+
+    return to_rows(block)
+
+
+def _zip_blocks_fn(lb, spans, *rbs):
+    """Zip one left block with the right-side slices covering its row
+    range. Columnar x columnar merges column dicts; otherwise rows pair
+    into merged dicts / tuples."""
+    from ray_tpu.data.block import (
+        concat_batches,
+        is_columnar,
+        slice_block,
+        to_rows,
+    )
+
+    pieces = [slice_block(rbs[i], s, e) for i, s, e in spans]
+    if is_columnar(lb) and isinstance(lb, dict) and pieces and \
+            all(isinstance(p, dict) and is_columnar(p) for p in pieces):
+        rbat = concat_batches(pieces)
+        out = dict(lb)
+        for k, v in rbat.items():
+            out[k if k not in out else k + "_1"] = v
+        return out
+    lr = to_rows(lb)
+    rr = [r for p in pieces for r in to_rows(p)]
+    out = []
+    for a, b in zip(lr, rr):
+        if isinstance(a, dict) and isinstance(b, dict):
+            m = dict(a)
+            for k, v in b.items():
+                m[k if k not in m else k + "_1"] = v
+            out.append(m)
+        else:
+            out.append((a, b))
+    return out
+
+
 def from_items(items, parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
     return Dataset.from_items(items, parallelism)
 
@@ -643,9 +833,19 @@ def range(n: int, parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:  # noqa: 
     return Dataset.range(n, parallelism)
 
 
-def from_numpy(arr: np.ndarray, parallelism: int = _DEFAULT_PARALLELISM
-               ) -> Dataset:
-    return Dataset.from_items(list(arr), parallelism)
+def from_numpy(arr, parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
+    """Columnar blocks straight from ndarray(s) — a dict maps column
+    names to arrays (reference: from_numpy building Arrow blocks). The
+    splits are views; ray_tpu.put ships them with out-of-band buffers,
+    so neither split nor store pays a row conversion."""
+    import ray_tpu
+
+    from ray_tpu.data.block import split_columnar
+
+    if not isinstance(arr, (dict, np.ndarray)):
+        arr = np.asarray(arr)
+    return Dataset([ray_tpu.put(b)
+                    for b in split_columnar(arr, parallelism)])
 
 
 def read_datasource(datasource, *,

@@ -50,7 +50,10 @@ def exchange(block_refs: list, fused: Callable[[list], list],
 
     @ray_tpu.remote(num_cpus=1, num_returns=P)
     def _map(idx, block):
-        parts = partitioner(fused(block), idx)
+        from ray_tpu.data.block import to_rows
+
+        # partitioners are row-oriented; columnar blocks convert here
+        parts = partitioner(to_rows(fused(block)), idx)
         return tuple(parts) if P > 1 else parts[0]
 
     @ray_tpu.remote(num_cpus=1)

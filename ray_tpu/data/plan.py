@@ -50,8 +50,10 @@ class MapRows(LogicalOperator):
     one_to_one = True
 
     def block_fn(self):
+        from ray_tpu.data.block import to_rows
+
         f = self.fn
-        return lambda b: [f(r) for r in b]
+        return lambda b: [f(r) for r in to_rows(b)]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -60,8 +62,10 @@ class FilterRows(LogicalOperator):
     name = "Filter"
 
     def block_fn(self):
+        from ray_tpu.data.block import to_rows
+
         f = self.fn
-        return lambda b: [r for r in b if f(r)]
+        return lambda b: [r for r in to_rows(b) if f(r)]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -70,8 +74,10 @@ class FlatMapRows(LogicalOperator):
     name = "FlatMap"
 
     def block_fn(self):
+        from ray_tpu.data.block import to_rows
+
         f = self.fn
-        return lambda b: [o for r in b for o in f(r)]
+        return lambda b: [o for r in to_rows(b) for o in f(r)]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -94,8 +100,10 @@ class Limit(LogicalOperator):
     name = "Limit"
 
     def block_fn(self):
+        from ray_tpu.data.block import slice_block
+
         n = self.n
-        return lambda b: b[:n]
+        return lambda b: slice_block(b, 0, n)
 
 
 @dataclasses.dataclass(frozen=True)

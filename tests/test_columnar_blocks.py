@@ -1,0 +1,206 @@
+"""Columnar (zero-copy) data blocks + union/zip.
+
+Reference model: data/_internal/arrow_block.py — blocks move between
+map stages as columnar tables whose payload never passes through
+pickle; here the audit rides the serialization layer's byte counters
+(core/serialization.STATS).
+"""
+
+import sys
+
+import cloudpickle
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.data.block import (
+    concat_batches,
+    is_columnar,
+    slice_block,
+    split_columnar,
+    to_batch,
+    to_rows,
+)
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 8,
+                                "store_capacity": 512 * 1024 * 1024})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+# ----------------------------------------------------------- block unit
+
+
+def test_block_format_roundtrip():
+    col = {"x": np.arange(10), "y": np.ones(10)}
+    assert is_columnar(col)
+    assert not is_columnar([{"x": 1}])
+    rows = to_rows(col)
+    assert rows[3]["x"] == 3
+    back = to_batch(rows)
+    np.testing.assert_array_equal(back["x"], col["x"])
+    sl = slice_block(col, 2, 5)
+    assert sl["x"].base is col["x"]  # a VIEW, not a copy
+    cat = concat_batches([slice_block(col, 0, 4), slice_block(col, 4, 10)])
+    np.testing.assert_array_equal(cat["x"], col["x"])
+    parts = split_columnar(col, 3)
+    assert [len(p["x"]) for p in parts] == [4, 3, 3]
+
+
+# ------------------------------------------------------------- pipeline
+
+
+def test_from_numpy_blocks_stay_columnar(cluster):
+    arr = np.arange(1000, dtype=np.float32).reshape(250, 4)
+    ds = rd.from_numpy(arr, parallelism=4)
+    blocks = [ray_tpu.get(r, timeout=60) for r in ds._block_refs]
+    assert all(isinstance(b, np.ndarray) for b in blocks)
+    out = np.concatenate(list(ds.iter_batches(batch_size=50)))
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_map_batches_numpy_keeps_columnar_blocks(cluster):
+    ds = rd.from_numpy({"x": np.arange(100, dtype=np.float64)},
+                       parallelism=4)
+    out = ds.map_batches(lambda b: {"x": b["x"] * 2, "sq": b["x"] ** 2})
+    blocks = [ray_tpu.get(r, timeout=120)
+              for r in out._execute()]
+    assert all(is_columnar(b) and isinstance(b, dict) for b in blocks)
+    got = concat_batches(blocks)
+    np.testing.assert_array_equal(got["x"], np.arange(100) * 2.0)
+    # row ops still work downstream of columnar blocks
+    rows = out.filter(lambda r: r["sq"] < 9).take_all()
+    assert [r["x"] for r in rows] == [0.0, 2.0, 4.0]
+
+
+def test_zero_pickle_of_block_payloads(cluster):
+    """VERDICT done-criterion: map_batches over big numeric blocks moves
+    payload exclusively through out-of-band buffers — the pickle stream
+    carries only envelopes (counter-instrumented at both the driver and
+    inside the worker)."""
+    from ray_tpu.core import serialization as ser
+
+    n = 4_000_000  # 32 MB of float64 payload
+    ser.reset_stats()
+    ds = rd.from_numpy({"x": np.random.default_rng(0).random(n)},
+                       parallelism=8)
+    put_pickle = ser.STATS["pickle_bytes"]
+    put_buffer = ser.STATS["buffer_bytes"]
+    assert put_buffer >= n * 8
+    assert put_pickle < 64 * 1024  # envelopes only
+
+    def audited_double(batch):
+        # runs in the WORKER: its deserialize of the input block must
+        # have ridden buffers, not the pickle stream
+        from ray_tpu.core import serialization as wser
+
+        s = wser.STATS
+        assert s["buffer_bytes"] >= batch["x"].nbytes, s
+        assert s["pickle_bytes"] < 0.01 * max(s["buffer_bytes"], 1), s
+        return {"x": batch["x"] * 2.0}
+
+    out = ds.map_batches(audited_double)
+    ser.reset_stats()
+    total = 0
+    for batch in out.iter_batches(batch_size=500_000):
+        total += len(batch["x"])
+    assert total == n
+    # driver-side read of the mapped blocks: payload via buffers
+    assert ser.STATS["buffer_bytes"] >= n * 8
+    assert ser.STATS["pickle_bytes"] < 0.01 * ser.STATS["buffer_bytes"]
+
+
+def test_repartition_columnar(cluster):
+    ds = rd.from_numpy({"x": np.arange(90)}, parallelism=9)
+    rp = ds.repartition(3)
+    blocks = [ray_tpu.get(r, timeout=60) for r in rp._block_refs]
+    assert len(blocks) == 3
+    assert all(is_columnar(b) for b in blocks)
+    np.testing.assert_array_equal(concat_batches(blocks)["x"],
+                                  np.arange(90))
+
+
+def test_union(cluster):
+    a = rd.from_numpy({"x": np.arange(10)})
+    b = rd.from_numpy({"x": np.arange(10, 30)})
+    c = rd.from_items([{"x": 99}]).map(lambda r: {"x": r["x"] + 1})
+    u = a.union(b, c)
+    assert u.count() == 31
+    xs = sorted(int(r["x"]) for r in u.take_all())
+    assert xs == list(range(30)) + [100]
+
+
+def test_zip_columnar_and_rows(cluster):
+    left = rd.from_numpy({"a": np.arange(20)}, parallelism=3)
+    right = rd.from_numpy({"b": np.arange(20) * 10,
+                           "a": np.arange(20) + 5}, parallelism=5)
+    z = left.zip(right)
+    rows = z.take_all()
+    assert len(rows) == 20
+    assert rows[7]["a"] == 7 and rows[7]["b"] == 70
+    assert rows[7]["a_1"] == 12  # right-side duplicate renamed
+    # row-format zip pairs into tuples
+    z2 = rd.from_items(list("abcd")).zip(rd.from_items([1, 2, 3, 4]))
+    assert z2.take_all() == [("a", 1), ("b", 2), ("c", 3), ("d", 4)]
+    with pytest.raises(ValueError, match="equal row counts"):
+        rd.from_items([1, 2]).zip(rd.from_items([1, 2, 3])).take_all()
+
+
+def test_union_applies_pending_actor_stage(cluster):
+    """Regression: union/zip must not silently drop a pending
+    map_batches(compute="actors") stage."""
+    a = rd.from_items([{"a": 1}, {"a": 2}]).map_batches(
+        lambda b: {"a": b["a"] * 10}, compute="actors")
+    u = a.union(rd.from_items([{"a": 3}]))
+    xs = sorted(int(r["a"]) for r in u.take_all())
+    assert xs == [3, 10, 20]
+
+
+def test_write_jsonl_and_parquet_columnar(cluster, tmp_path):
+    """Regression: writers must emit ROWS from columnar blocks, not
+    column names."""
+    import json
+
+    ds = rd.from_numpy({"x": np.arange(3)}, parallelism=1)
+    paths = ds.write_jsonl(str(tmp_path / "j"))
+    rows = [json.loads(line) for p in paths for line in open(p)]
+    assert rows == [{"x": 0}, {"x": 1}, {"x": 2}]
+    try:
+        import pyarrow.parquet as pq
+    except ImportError:
+        return
+    ppaths = ds.write_parquet(str(tmp_path / "p"))
+    table = pq.read_table(ppaths[0])
+    assert table.to_pylist() == [{"x": 0}, {"x": 1}, {"x": 2}]
+
+
+def test_mixed_columnar_union_repartition_falls_back_to_rows(cluster):
+    u = rd.from_numpy(np.arange(4)).union(
+        rd.from_numpy({"a": np.arange(4)}))
+    rp = u.repartition(2)
+    assert rp.count() == 8
+
+
+def test_iter_jax_batches_from_columnar(cluster):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    ds = rd.from_numpy({"x": np.arange(64, dtype=np.float32)},
+                       parallelism=4)
+    batches = list(ds.iter_jax_batches(batch_size=16))
+    assert len(batches) == 4
+    assert all(isinstance(b["x"], jax.Array) for b in batches)
+    np.testing.assert_array_equal(
+        np.concatenate([np.asarray(b["x"]) for b in batches]),
+        np.arange(64, dtype=np.float32))
