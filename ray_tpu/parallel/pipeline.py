@@ -74,3 +74,82 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name: str = "pipe",
         jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs)),
         axis_name)
     return outputs.reshape(B, *x.shape[1:])
+
+
+def pipeline_apply_interleaved(stage_fn, stage_params, x,
+                               axis_name: str = "pipe",
+                               num_microbatches: int | None = None,
+                               num_repeats: int = 1) -> jax.Array:
+    """Interleaved (circular) pipeline schedule — the TPU-native answer
+    to Megatron's interleaved 1F1B (reference role: virtual pipeline
+    stages, megatron/core/pipeline_parallel/schedules.py; jax shape:
+    MaxText's circular pipeline). Each device holds `num_repeats`
+    VIRTUAL stages (round-robin placement: device s owns virtual stages
+    s, s+S, ..), so the per-device bubble drops from (S-1)/M to
+    (S-1)/(R*M); under jax autodiff the scan's backward runs the
+    mirrored schedule, interleaving per-microbatch forward/backward the
+    way hand-scheduled 1F1B does on GPU runtimes.
+
+    Schedule (M microbatches, S devices, R repeats, V = S*R virtual
+    stages): microbatch m enters repeat r at tick r*M + m; at tick t,
+    device s processes microbatch (t - s) mod M at repeat (t - s) // M —
+    no collisions, one stage-execution per device per tick. Activations
+    leaving the last device park in a circular buffer until their next
+    repeat's entry tick. Total ticks R*M + S - 1.
+
+    `stage_params` is THIS device's (R, ...) stack of virtual-stage
+    params (caller shards the (V, ...) stack over `axis_name` with
+    round-robin order: virtual stage v lives at device v % S, slot
+    v // S). Requires M >= S (the park time M-S+1 must be >= 1... it is
+    >= 0; M >= S keeps the buffer causal).
+    """
+    S = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    R = num_repeats
+    B = x.shape[0]
+    M = num_microbatches or S
+    assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
+    assert M >= S, f"interleaved schedule needs microbatches {M} >= stages {S}"
+    mb = B // M
+    micro = x.reshape(M, mb, *x.shape[1:])
+
+    n_ticks = R * M + S - 1
+    shift_perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(carry, t):
+        held, circ, outputs = carry
+        # device s works on microbatch m=(t-s) mod M, repeat r=(t-s)//M
+        age = t - stage
+        m = jnp.mod(age, M)
+        r = jnp.clip(age // M, 0, R - 1)
+        active = jnp.logical_and(age >= 0, age < R * M)
+        # stage 0 ingest: fresh microbatch on repeat 0, parked wrap after
+        feed = jnp.where(age < M, micro[jnp.clip(m, 0, M - 1)],
+                         circ[jnp.clip(m, 0, M - 1)])
+        held = jnp.where(stage == 0, feed, held)
+        params_r = jax.tree.map(lambda p: p[r], stage_params)
+        out = jnp.where(active, stage_fn(params_r, held),
+                        jnp.zeros_like(held))
+        # last stage at a non-final repeat: the activation wraps — it
+        # reaches stage 0 next tick and parks in circ until its entry
+        # tick (r+1)*M + m; slot m == (arrival_tick - S) mod M
+        emit_final = jnp.logical_and(stage == S - 1,
+                                     jnp.logical_and(active, r == R - 1))
+        outputs = outputs.at[jnp.clip(m, 0, M - 1)].add(
+            jnp.where(emit_final, out, jnp.zeros_like(out)))
+        held = lax.ppermute(out, axis_name, shift_perm)
+        park_slot = jnp.mod(t + 1 - S, M)
+        park = jnp.logical_and(stage == 0, t + 1 >= S)
+        circ = circ.at[jnp.clip(park_slot, 0, M - 1)].set(
+            jnp.where(park, held, circ[jnp.clip(park_slot, 0, M - 1)]))
+        return (held, circ, outputs), None
+
+    held0 = jnp.zeros_like(micro[0])
+    circ0 = jnp.zeros((M, mb, *x.shape[1:]), x.dtype)
+    out0 = jnp.zeros((M, mb, *x.shape[1:]), x.dtype)
+    (_, _, outputs), _ = lax.scan(tick, (held0, circ0, out0),
+                                  jnp.arange(n_ticks))
+    outputs = lax.psum(
+        jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name)
+    return outputs.reshape(B, *x.shape[1:])
