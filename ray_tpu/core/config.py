@@ -19,6 +19,10 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     # --- rpc/transport
     "NODE_IP": (str, "", "bind/advertise IP ('' = loopback, 'auto' = detect)"),
     "RPC_TIMEOUT_S": (float, 30.0, "default blocking RPC timeout"),
+    "ONEWAY_BATCH_WINDOW_MS": (float, 1.0,
+                               "coalesce small oneways per peer for this "
+                               "window (0 = send each immediately)"),
+    "ONEWAY_BATCH_MAX": (int, 128, "flush a oneway batch at this size"),
     "TESTING_RPC_FAILURE": (str, "", "chaos: 'method=N,...' drop budgets"),
     # --- head
     "HEARTBEAT_INTERVAL_S": (float, 0.5, "nodelet->head resource heartbeat"),

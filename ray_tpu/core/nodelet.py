@@ -198,6 +198,7 @@ class Nodelet:
         s.register("list_node_objects", self._h_list_node_objects, slow=True)
         s.register("list_logs", self._h_list_logs)
         s.register("tail_log", self._h_tail_log)
+        s.register("node_stats", self._h_node_stats)
         s.register("ping", lambda m, f: "pong")
 
         self._threads = [
@@ -263,6 +264,46 @@ class Nodelet:
     # Log streaming (reference: the dashboard log monitor,
     # python/ray/_private/log_monitor.py:103 — per-node agent tails
     # worker logs for the dashboard/CLI; here the nodelet serves them).
+
+    def _h_node_stats(self, msg, frames):
+        """Per-node agent stats (reference: dashboard/agent.py — the
+        per-node tier collecting process/host stats for the dashboard;
+        here the nodelet IS the agent, so the stats ride its RPC server
+        instead of a separate process)."""
+        def rss_kb(pid: int) -> int:
+            try:
+                with open(f"/proc/{pid}/statm") as f:
+                    return int(f.read().split()[1]) * \
+                        (os.sysconf("SC_PAGE_SIZE") // 1024)
+            except (OSError, ValueError, IndexError):
+                return 0
+
+        with self._lock:
+            workers = [
+                {"worker_id": w.worker_id.hex(),
+                 "pid": getattr(w.proc, "pid", None),
+                 "idle": w.idle,
+                 "actor_id": w.actor_id.hex() if w.actor_id else None,
+                 "rss_kb": rss_kb(getattr(w.proc, "pid", 0) or 0)}
+                for w in self._workers.values()
+            ]
+            avail = dict(self._available)
+            qlen = len(self._queue)
+        try:
+            load1, load5, load15 = os.getloadavg()
+        except OSError:
+            load1 = load5 = load15 = 0.0
+        return {
+            "node_id": self.node_id.hex(),
+            "address": self.address,
+            "loadavg": [load1, load5, load15],
+            "num_workers": len(workers),
+            "workers": workers,
+            "queue_len": qlen,
+            "resources": dict(self.resources),
+            "available": avail,
+            "store": self.store.stats(),
+        }
 
     def _h_list_logs(self, msg, frames):
         out = []

@@ -136,7 +136,12 @@ def _deserialize(buf: memoryview) -> tuple[Any, int]:
     off = _pad(off + plen)
     oob = []
     for bl in blens:
-        oob.append(buf[off:off + bl])
+        # READ-ONLY views: zero-copy arrays alias the shared-memory
+        # store — a consumer mutating one in place would silently
+        # corrupt the stored object for every other reader (reference:
+        # Ray marks zero-copy numpy arrays immutable for this reason).
+        # In-place writes now raise; mutate a copy instead.
+        oob.append(buf[off:off + bl].toreadonly())
         off = _pad(off + bl)
     STATS["deserialize_calls"] += 1
     STATS["pickle_bytes"] += plen

@@ -62,12 +62,76 @@ async function load() {
     h += `<tr><td>${j.submission_id}</td><td>${j.status}</td>` +
          `<td>${j.entrypoint}</td></tr>`;
   h += '</table>';
+  const train = await (await fetch('/api/train')).json();
+  h += '<h2>Train runs</h2><table><tr><th>name</th><th>status</th>' +
+       '<th>iteration</th><th>workers</th></tr>';
+  for (const t of train)
+    h += `<tr><td>${t.name}</td><td>${t.status}</td>` +
+         `<td>${t.iteration}</td><td>${t.num_workers||''}</td></tr>`;
+  h += '</table>';
+  const serve = await (await fetch('/api/serve')).json();
+  h += '<h2>Serve</h2><pre>' +
+       JSON.stringify(serve, null, 1).slice(0, 4000) + '</pre>';
+  const data = await (await fetch('/api/data')).json();
+  h += '<h2>Data executions</h2><table><tr><th>id</th><th>status</th>' +
+       '<th>submitted</th><th>yielded</th></tr>';
+  for (const d of data)
+    h += `<tr><td>${d.name}</td><td>${d.status}</td>` +
+         `<td>${d.submitted}</td><td>${d.yielded}</td></tr>`;
+  h += '</table>';
   document.getElementById('content').innerHTML = h;
 }
 load();
 </script></body></html>"""
 
 _server = None
+
+# -------------------------------------------------- subsystem views
+# Train/Data publish lightweight run records into the head KV under the
+# "dashboard" namespace; /api/train and /api/data list them (reference:
+# dashboard/modules/train + modules/data reading subsystem state).
+
+
+def publish_view(kind: str, name: str, payload: dict,
+                 address: str | None = None):
+    """Best-effort: write one subsystem record into head KV."""
+    try:
+        from ray_tpu.core.gcs_client import GcsClient
+
+        payload = {**payload, "name": name, "updated_at": time.time()}
+        GcsClient(address).internal_kv_put(
+            f"{kind}/{name}", json.dumps(payload, default=str).encode(),
+            namespace="dashboard")
+    except Exception:  # noqa: BLE001
+        pass  # no cluster runtime / head gone: views are optional
+
+
+def read_views(kind: str, address: str | None = None) -> list[dict]:
+    try:
+        from ray_tpu.core.gcs_client import GcsClient
+
+        gcs = GcsClient(address)
+        out = []
+        for key in gcs.internal_kv_keys(f"{kind}/", namespace="dashboard"):
+            raw = gcs.internal_kv_get(key, namespace="dashboard")
+            if raw:
+                try:
+                    out.append(json.loads(raw))
+                except ValueError:
+                    pass
+        out.sort(key=lambda r: r.get("updated_at", 0), reverse=True)
+        return out
+    except Exception:  # noqa: BLE001
+        return []
+
+
+def _serve_view(head_address) -> dict:
+    try:
+        from ray_tpu.util.state import serve_status
+
+        return serve_status(head_address)
+    except Exception as e:  # noqa: BLE001
+        return {"error": repr(e), "apps": {}}
 
 
 def _sample_loop(head_address, stop: threading.Event):
@@ -131,6 +195,28 @@ def start_dashboard(head_address: str | None = None, port: int = 8265) -> int:
                 elif self.path == "/api/history":
                     self._send(json.dumps(list(_history)).encode(),
                                "application/json")
+                elif self.path == "/api/train":
+                    self._send(json.dumps(
+                        read_views("train", head_address)).encode(),
+                        "application/json")
+                elif self.path == "/api/data":
+                    self._send(json.dumps(
+                        read_views("data", head_address)).encode(),
+                        "application/json")
+                elif self.path == "/api/serve":
+                    self._send(json.dumps(
+                        _serve_view(head_address), default=str).encode(),
+                        "application/json")
+                elif self.path.startswith("/api/node_stats"):
+                    # /api/node_stats?node=<hex> — the per-node agent
+                    # tier through the nodelet (dashboard/agent.py role)
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+                    node = (q.get("node") or [""])[0]
+                    self._send(json.dumps(
+                        state.node_stats(node, head_address)).encode(),
+                        "application/json")
                 elif self.path == "/metrics":
                     self._send(metrics_mod.prometheus_text().encode(),
                                "text/plain; version=0.0.4")

@@ -114,11 +114,16 @@ class Dataset:
             for t in self._stream_thunks]
         return _StreamingInput(gens)
 
+    def _is_plain_stream(self) -> bool:
+        """No side stages outside the op list (actor map stage or
+        streaming source) — the parts an op-chain consumer can't see."""
+        return self._stream_thunks is None and \
+            getattr(self, "_actor_stage", None) is None
+
     def _is_plain_blocks(self) -> bool:
         """True when _block_refs already IS the dataset: no pending
         ops, no actor map stage, no streaming source."""
-        return not self._ops and self._stream_thunks is None and \
-            getattr(self, "_actor_stage", None) is None
+        return not self._ops and self._is_plain_stream()
 
     def _require_eager(self, what: str):
         if self._stream_thunks is not None:
@@ -327,8 +332,7 @@ class Dataset:
         map stage is per-block, so a per-block limit would leak n rows
         PER BLOCK into the shuffle instead of n total."""
         if any(isinstance(o, Limit) for o in self._ops) or \
-                self._stream_thunks is not None or \
-                getattr(self, "_actor_stage", None) is not None:
+                not self._is_plain_stream():
             rows = self.take_all()
             ds = Dataset.from_items(rows, max(1, len(self._block_refs)))
             return ds._block_refs, []
@@ -617,8 +621,7 @@ class Dataset:
 
         from ray_tpu.data.block import block_num_rows
 
-        if not self._ops and getattr(self, "_actor_stage", None) is None \
-                and self._stream_thunks is None:
+        if self._is_plain_blocks():
             return sum(block_num_rows(b) for b in
                        ray_tpu.get(list(self._block_refs), timeout=600))
         return sum(1 for _ in self.iter_rows())
@@ -671,10 +674,8 @@ class Dataset:
             path = _os.path.join(directory, f"part-{i:05d}.jsonl")
             with open(path, "w") as f:
                 for row in _to_rows(block):
-                    # numpy scalars (columnar rows) serialize as numbers
-                    f.write(json.dumps(
-                        row, default=lambda o: o.item()
-                        if hasattr(o, "item") else str(o)) + "\n")
+                    # numpy values serialize as numbers/lists, not strs
+                    f.write(json.dumps(row, default=_json_default) + "\n")
             paths.append(path)
         return paths
 
@@ -790,6 +791,17 @@ def _to_rows(block):
     from ray_tpu.data.block import to_rows
 
     return to_rows(block)
+
+
+def _json_default(o):
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if hasattr(o, "item"):
+        try:
+            return o.item()  # numpy scalar
+        except ValueError:
+            pass
+    return str(o)
 
 
 def _zip_blocks_fn(lb, spans, *rbs):

@@ -13,7 +13,12 @@ training out of host RAM.
 
 from __future__ import annotations
 
+import os
 from typing import Iterator
+
+# dashboard "data" view key ring: bounded records per driver process
+_RUN_SEQ = 0
+_MAX_RUN_RECORDS = 20
 
 
 class ExecutionStats:
@@ -116,7 +121,28 @@ class StreamingExecutor:
         poll = getattr(input_refs, "poll", None)
         it = iter(input_refs) if poll is None else None
         exhausted = False
+        # dashboard data view: one record per execution, refreshed as
+        # blocks flow (reference: dashboard/modules/data). Keys rotate
+        # through a bounded per-process ring so a long-lived driver
+        # looping over dataset executions cannot grow head KV unbounded.
+        global _RUN_SEQ
+        _RUN_SEQ += 1
+        run_id = f"exec_{os.getpid()}_{_RUN_SEQ % _MAX_RUN_RECORDS}"
+        last_pub = 0.0
+
+        def _pub(status):
+            from ray_tpu import dashboard as _dash
+
+            _dash.publish_view("data", run_id, {
+                "status": status, "submitted": stats.submitted,
+                "yielded": stats.yielded, "in_flight": stats.in_flight,
+                "buffered_bytes": stats.buffered_bytes,
+                "backpressure_waits": stats.backpressure_waits})
+
         while not exhausted or window:
+            if _t.monotonic() - last_pub > 2.0:
+                last_pub = _t.monotonic()
+                _pub("RUNNING")
             # account completed-but-unconsumed bytes
             stats.buffered_bytes = sum(_ref_size(r) for r in window)
             stats.peak_buffered_bytes = max(stats.peak_buffered_bytes,
@@ -157,3 +183,4 @@ class StreamingExecutor:
                 _t.sleep(0.01)
             else:
                 _t.sleep(0.005)
+        _pub("FINISHED")

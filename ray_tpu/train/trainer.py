@@ -170,13 +170,22 @@ class JaxTrainer:
         failures = 0
         history: list[dict] = []
         last_error: BaseException | None = None
+        from ray_tpu import dashboard as _dash
+
+        _dash.publish_view("train", name, {
+            "status": "RUNNING", "iteration": 0,
+            "num_workers": self.scaling_config.num_workers})
         while True:
             wg = None
             try:
                 target, resize_to = resize_to, None  # one-shot: a FAILED
                 # resized start must not retry the stale target forever
                 wg = self._start_worker_group(name, exp_dir, resume, target)
-                metrics, ckpt = self._result_loop(wg, manager, history)
+                metrics, ckpt = self._result_loop(wg, manager, history,
+                                                  run_name=name)
+                _dash.publish_view("train", name, {
+                    "status": "FINISHED", "iteration": len(history),
+                    "num_workers": wg.num_workers, "metrics": metrics})
                 return Result(metrics=metrics, checkpoint=ckpt or
                               manager.latest(), path=exp_dir,
                               metrics_history=history)
@@ -194,6 +203,9 @@ class JaxTrainer:
                 last_error = e
                 failures += 1
                 if failures > failure_config.max_failures:
+                    _dash.publish_view("train", name, {
+                        "status": "FAILED", "iteration": len(history),
+                        "error": str(e)})
                     raise TrainingFailedError(
                         f"training failed after {failures - 1} restarts: {e}"
                     ) from e
@@ -345,7 +357,8 @@ class JaxTrainer:
         return tpu_mod.topology_env(labels, slice_ips, worker_id=position)
 
     def _result_loop(self, wg: WorkerGroup, manager: CheckpointManager,
-                     history: list) -> tuple[dict, Checkpoint | None]:
+                     history: list, run_name: str = ""
+                     ) -> tuple[dict, Checkpoint | None]:
         """Drive rounds of per-worker reports until every worker finishes
         (reference: backend_executor.get_next_results — all workers must
         report in lockstep)."""
@@ -412,6 +425,14 @@ class JaxTrainer:
                         last_ckpt = manager.register(
                             Checkpoint(rank0["checkpoint_dir"]),
                             last_metrics)
+                    if run_name:
+                        from ray_tpu import dashboard as _dash
+
+                        _dash.publish_view("train", run_name, {
+                            "status": "RUNNING",
+                            "iteration": len(history),
+                            "num_workers": wg.num_workers,
+                            "metrics": last_metrics})
         return last_metrics, last_ckpt
 
 

@@ -56,6 +56,16 @@ def _node_address(node_id: str, address: str | None) -> str:
     raise ValueError(f"no live node matching {node_id!r}")
 
 
+def node_stats(node_id: str, address: str | None = None) -> dict:
+    """Per-node agent stats through the nodelet (reference:
+    dashboard/agent.py stats collection — loadavg, per-worker RSS,
+    store usage)."""
+    from ray_tpu.core.rpc import RpcClient
+
+    target = _node_address(node_id, address)
+    return RpcClient.shared().call(target, "node_stats", {}, timeout=30)
+
+
 def list_logs(node_id: str, address: str | None = None) -> list[dict]:
     """Log files on a node (reference: `ray logs` / the dashboard log
     monitor, _private/log_monitor.py:103)."""
