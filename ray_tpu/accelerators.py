@@ -1,0 +1,144 @@
+"""Accelerator plugin registry — the generic seam over device types.
+
+Reference parity: ray._private.accelerators (accelerators/__init__.py
+registry + AcceleratorManager ABC, accelerators/accelerator.py:23):
+each accelerator type implements detection (how many on this node,
+what type), node labeling, and per-worker visibility handoff; the
+resource layer stays generic over the registry. TPU is the first-class
+implementation (delegating to core/tpu.py slice identity); the NVIDIA
+manager shows the seam generalizes — it detects via the standard env/
+driver paths and manages CUDA_VISIBLE_DEVICES, though no GPU exists in
+this image to exercise it.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class AcceleratorManager:
+    """One accelerator family (reference: AcceleratorManager ABC —
+    accelerator.py:23)."""
+
+    # resource name in resource dicts ({"TPU": 1})
+    resource_name: str = ""
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        """Devices physically present on this node (0 = none)."""
+        raise NotImplementedError
+
+    @staticmethod
+    def get_current_node_accelerator_type() -> str | None:
+        """Family/pod type string, e.g. "v5e" / "A100"."""
+        return None
+
+    @staticmethod
+    def get_current_node_labels() -> dict[str, str]:
+        """Identity labels to assert on the node (slice/topology)."""
+        return {}
+
+    @staticmethod
+    def configure_worker_env(env: dict, claimed: bool):
+        """Mutate a worker's spawn env: hand the device through when the
+        worker's resources claim it, hide it otherwise."""
+
+
+class TPUAcceleratorManager(AcceleratorManager):
+    """TPU via the jax/axon runtime (reference:
+    accelerators/tpu.py:19-170)."""
+
+    resource_name = "TPU"
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        # avoid initializing a jax backend just to count: the axon pool
+        # env marks a tunnel-attached chip; TPU_CHIPS_PER_HOST covers
+        # real TPU VMs
+        if os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS"):
+            try:
+                return int(os.environ.get("TPU_CHIPS_PER_HOST", "4"))
+            except ValueError:
+                return 4
+        return 1 if os.environ.get("PALLAS_AXON_POOL_IPS") else 0
+
+    @staticmethod
+    def get_current_node_accelerator_type() -> str | None:
+        from ray_tpu.core import tpu as tpu_mod
+
+        return tpu_mod.detect_slice_labels().get(tpu_mod.POD_TYPE_LABEL)
+
+    @staticmethod
+    def get_current_node_labels() -> dict[str, str]:
+        from ray_tpu.core import tpu as tpu_mod
+
+        return tpu_mod.detect_slice_labels()
+
+    @staticmethod
+    def configure_worker_env(env: dict, claimed: bool):
+        if claimed:
+            # hand the chip through (reference: TPU_VISIBLE_CHIPS
+            # management, accelerators/tpu.py:157-170)
+            env.pop("JAX_PLATFORMS", None)
+            if "RAY_TPU_AXON_POOL_IPS" in env:
+                env["PALLAS_AXON_POOL_IPS"] = env["RAY_TPU_AXON_POOL_IPS"]
+        else:
+            # never grab the (single) chip by default; park the pool env
+            # so a later TPU-claiming worker can restore it
+            if "PALLAS_AXON_POOL_IPS" in env:
+                env["RAY_TPU_AXON_POOL_IPS"] = \
+                    env.pop("PALLAS_AXON_POOL_IPS")
+            env["JAX_PLATFORMS"] = "cpu"
+
+
+class NvidiaGPUAcceleratorManager(AcceleratorManager):
+    """NVIDIA via the standard driver/env surface (reference:
+    accelerators/nvidia_gpu.py). Present to prove the seam is generic;
+    this image has no GPU."""
+
+    resource_name = "GPU"
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        try:
+            return len(os.listdir("/proc/driver/nvidia/gpus"))
+        except OSError:
+            return 0
+
+    @staticmethod
+    def configure_worker_env(env: dict, claimed: bool):
+        if not claimed:
+            env["CUDA_VISIBLE_DEVICES"] = ""
+        else:
+            env.pop("CUDA_VISIBLE_DEVICES", None)
+
+
+_REGISTRY: dict[str, type[AcceleratorManager]] = {}
+
+
+def register(manager: type[AcceleratorManager]):
+    _REGISTRY[manager.resource_name] = manager
+    return manager
+
+
+def get_manager(resource_name: str) -> type[AcceleratorManager] | None:
+    return _REGISTRY.get(resource_name)
+
+
+def all_managers() -> dict[str, type[AcceleratorManager]]:
+    return dict(_REGISTRY)
+
+
+def detect_node_resources() -> dict[str, float]:
+    """Auto-detected accelerator resources for this node (reference:
+    resource autodetection at node start)."""
+    out: dict[str, float] = {}
+    for name, mgr in _REGISTRY.items():
+        n = mgr.get_current_node_num_accelerators()
+        if n > 0:
+            out[name] = float(n)
+    return out
+
+
+register(TPUAcceleratorManager)
+register(NvidiaGPUAcceleratorManager)

@@ -303,6 +303,9 @@ class Nodelet:
             "resources": dict(self.resources),
             "available": avail,
             "store": self.store.stats(),
+            # per-method handler/queue-lag stats (reference:
+            # common/event_stats.h — the event-loop instrumentation)
+            "event_stats": self.server.event_stats(),
         }
 
     def _h_list_logs(self, msg, frames):
@@ -391,7 +394,8 @@ class Nodelet:
 
     def _spawn_worker(self, tpu: bool = False,
                       runtime_env: dict | None = None,
-                      lease_id: bytes | None = None) -> _Worker:
+                      lease_id: bytes | None = None,
+                      claims: dict | None = None) -> _Worker:
         from ray_tpu.core import runtime_env as rtenv
         from ray_tpu.core.ids import WorkerID
 
@@ -422,22 +426,19 @@ class Nodelet:
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
         env["RAY_TPU_WORKER_ID"] = wid.hex()
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        # device visibility handoff through the accelerator plugin
+        # registry (reference: AcceleratorManager.set_*_visible_devices,
+        # _private/accelerators/) — a worker claiming the accelerator
+        # resource gets the device handed through; others get it hidden
+        # (which also skips the sitecustomize jax import, ~2s per spawn)
+        from ray_tpu import accelerators as _acc
+
+        claims = dict(claims or {})
         if tpu:
-            # Worker legitimately claims the TPU resource: hand the chip
-            # through (reference: TPU_VISIBLE_CHIPS management,
-            # _private/accelerators/tpu.py:157-170).
-            env.pop("JAX_PLATFORMS", None)
-            if "RAY_TPU_AXON_POOL_IPS" in env:
-                env["PALLAS_AXON_POOL_IPS"] = env["RAY_TPU_AXON_POOL_IPS"]
-        else:
-            # Workers must never grab the (single) TPU by default; tasks
-            # that need the chip opt in via resources (driver holds the
-            # device). Dropping the axon pool env also skips the
-            # sitecustomize jax import (~2s saved per worker spawn); the
-            # original value is preserved for TPU-claiming workers above.
-            if "PALLAS_AXON_POOL_IPS" in env:
-                env["RAY_TPU_AXON_POOL_IPS"] = env.pop("PALLAS_AXON_POOL_IPS")
-            env["JAX_PLATFORMS"] = "cpu"
+            claims.setdefault("TPU", 1.0)
+        for mgr in _acc.all_managers().values():
+            mgr.configure_worker_env(
+                env, claimed=claims.get(mgr.resource_name, 0) > 0)
         log = open(os.path.join(self.log_dir, f"worker-{wid.hex()[:12]}.log"), "ab")
         proc = subprocess.Popen(
             [py_exe or sys.executable, "-m", "ray_tpu.core.worker_main"],
@@ -548,7 +549,8 @@ class Nodelet:
         if w is None:
             try:
                 w = self._spawn_worker(tpu=needs_tpu, runtime_env=runtime_env,
-                                       lease_id=lease_id)
+                                       lease_id=lease_id,
+                                       claims=resources)
             except Exception as e:  # noqa: BLE001
                 with self._lock:
                     self._pending_spawns -= 1
@@ -1182,7 +1184,8 @@ class Nodelet:
                 if w is None:
                     try:
                         w = self._spawn_worker(tpu=needs_tpu,
-                                               runtime_env=spec.runtime_env)
+                                               runtime_env=spec.runtime_env,
+                                               claims=spec.resources)
                     except Exception as e:  # noqa: BLE001
                         # bad runtime env (missing KV blob, corrupt zip,
                         # head unreachable) must not kill the dispatch
@@ -1275,7 +1278,8 @@ class Nodelet:
                     free[r] = free.get(r, 0.0) - q
         try:
             w = self._spawn_worker(tpu=needs_tpu,
-                                   runtime_env=spec.runtime_env)
+                                   runtime_env=spec.runtime_env,
+                                   claims=spec.resources)
         except Exception:
             # env materialization failed: roll back the bundle decrement
             # or the PG permanently loses capacity on this node

@@ -315,6 +315,9 @@ class RpcServer:
                                              thread_name_prefix=f"{name}-s")
         self._name = name
         self._owner: _SocketOwner | None = None
+        # per-method event stats (count / handler ms / queue-lag ms)
+        self._stats_lock = threading.Lock()
+        self._event_stats: dict[str, dict] = {}
 
     def register(self, method: str, fn, oneway: bool = False,
                  slow: bool = False):
@@ -350,11 +353,24 @@ class RpcServer:
                 else self._pool)
         try:
             pool.submit(self._dispatch, ident, msg_id, method,
-                        payload, frames)
+                        payload, frames, time.perf_counter())
         except RuntimeError:
             pass  # pool shut down mid-teardown: drop
 
-    def _dispatch(self, ident, msg_id, method, payload, frames):
+    def event_stats(self) -> dict:
+        """Per-method handler stats (reference: common/event_stats.h —
+        the event-loop lag instrumentation the sanitizer builds read):
+        count, total/max handler ms, and total/max QUEUE LAG ms (time a
+        message waited for a pool thread — the 'event loop stalled'
+        signal)."""
+        with self._stats_lock:
+            return {m: dict(v) for m, v in self._event_stats.items()}
+
+    def _dispatch(self, ident, msg_id, method, payload, frames,
+                  submitted_at: float | None = None):
+        t_start = time.perf_counter()
+        lag_ms = ((t_start - submitted_at) * 1e3
+                  if submitted_at is not None else 0.0)
         entry = self._handlers.get(method)
         if entry is None:
             self._reply(ident, msg_id, _ERR,
@@ -364,6 +380,7 @@ class RpcServer:
         try:
             msg = ser.loads_msg(payload) if payload else {}
             result = fn(msg, frames)
+            self._record_event(method, t_start, lag_ms)
             if oneway:
                 return
             out_frames = []
@@ -378,6 +395,18 @@ class RpcServer:
                 except Exception:
                     blob = ser.dumps_msg(RpcError(repr(e)))
                 self._reply(ident, msg_id, _ERR, blob)
+
+    def _record_event(self, method: str, t_start: float, lag_ms: float):
+        dur_ms = (time.perf_counter() - t_start) * 1e3
+        with self._stats_lock:
+            s = self._event_stats.setdefault(method, {
+                "count": 0, "total_ms": 0.0, "max_ms": 0.0,
+                "total_lag_ms": 0.0, "max_lag_ms": 0.0})
+            s["count"] += 1
+            s["total_ms"] += dur_ms
+            s["max_ms"] = max(s["max_ms"], dur_ms)
+            s["total_lag_ms"] += lag_ms
+            s["max_lag_ms"] = max(s["max_lag_ms"], lag_ms)
 
     def _reply(self, ident, msg_id, status, payload, frames=()):
         try:

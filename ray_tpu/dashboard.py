@@ -92,18 +92,47 @@ _server = None
 # dashboard/modules/train + modules/data reading subsystem state).
 
 
+_publish_q: "deque" = deque(maxlen=64)  # drop-oldest when the head lags
+_publish_wake = threading.Event()
+_publisher_started = False
+_publisher_lock = threading.Lock()
+
+
 def publish_view(kind: str, name: str, payload: dict,
                  address: str | None = None):
-    """Best-effort: write one subsystem record into head KV."""
-    try:
-        from ray_tpu.core.gcs_client import GcsClient
+    """Best-effort: write one subsystem record into head KV. The RPC
+    runs on a background publisher thread (short timeout, drop-oldest
+    queue) so a slow or unreachable head can never stall the caller's
+    hot loop (train result loop / data executor)."""
+    payload = {**payload, "name": name, "updated_at": time.time()}
+    _publish_q.append((kind, name, payload, address))
+    global _publisher_started
+    with _publisher_lock:
+        if not _publisher_started:
+            _publisher_started = True
+            threading.Thread(target=_publish_loop, daemon=True,
+                             name="dashboard-publish").start()
+    _publish_wake.set()
 
-        payload = {**payload, "name": name, "updated_at": time.time()}
-        GcsClient(address).internal_kv_put(
-            f"{kind}/{name}", json.dumps(payload, default=str).encode(),
-            namespace="dashboard")
-    except Exception:  # noqa: BLE001
-        pass  # no cluster runtime / head gone: views are optional
+
+def _publish_loop():
+    from ray_tpu.core.gcs_client import GcsClient
+
+    while True:
+        _publish_wake.wait(timeout=5.0)
+        _publish_wake.clear()
+        while _publish_q:
+            try:
+                kind, name, payload, address = _publish_q.popleft()
+            except IndexError:
+                break
+            try:
+                GcsClient(address, timeout=2.0).internal_kv_put(
+                    f"{kind}/{name}",
+                    json.dumps(payload, default=str).encode(),
+                    namespace="dashboard")
+            except Exception:  # noqa: BLE001
+                pass  # no cluster runtime / head gone: views are optional
 
 
 def read_views(kind: str, address: str | None = None) -> list[dict]:

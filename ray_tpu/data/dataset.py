@@ -260,6 +260,22 @@ class Dataset:
         rows = [r for b in blocks for r in _to_rows(b)]
         return Dataset.from_items(rows, num_blocks)
 
+    def join(self, other: "Dataset", on: str, how: str = "inner",
+             num_blocks: int | None = None) -> "Dataset":
+        """Hash join on a key column (reference: Dataset.join — hash
+        shuffle co-partitioning both sides, then per-partition probe).
+        `how`: "inner" or "left"; right-side duplicate columns get a
+        "_1" suffix."""
+        if how not in ("inner", "left"):
+            raise ValueError(f"how must be 'inner' or 'left', got {how!r}")
+        from ray_tpu.data.exchange import join_exchange
+
+        lrefs, lops = self._exchange_input()
+        rrefs, rops = other._exchange_input()
+        refs = join_exchange(lrefs, _fuse(lops), rrefs, _fuse(rops),
+                             self._out_partitions(num_blocks), on, how)
+        return Dataset(refs)
+
     def union(self, *others: "Dataset") -> "Dataset":
         """Concatenate datasets block-wise (reference: Dataset.union —
         no driver materialization of rows; pending plans execute into
@@ -428,11 +444,19 @@ class Dataset:
         import ray_tpu as rt
 
         class _PoolWorker:
+            def ready(self):
+                return True
+
             def apply(self, block):
                 return apply_fn(fused(block))
 
         cls = rt.remote(num_cpus=1)(_PoolWorker)
         actors = [cls.remote() for _ in builtins.range(num_actors)]
+        # wait for the pool to come up with a generous budget: worker
+        # spawn under load can exceed the per-call actor-ready timeout,
+        # and a half-started pool surfaces as ActorUnavailableError mid-
+        # stream (reference: ActorPool waits on ready refs)
+        rt.get([a.ready.remote() for a in actors], timeout=180)
         try:
             # same resource-managed executor as the task path: the actor
             # pool must not outrun the consumer's memory budget either

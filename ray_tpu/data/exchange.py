@@ -27,7 +27,17 @@ def _stable_hash(key) -> int:
     """Process-stable hash for partitioning. Python's hash() is salted
     per process (PYTHONHASHSEED) — map tasks run in different worker
     processes, so salted hashes would scatter one group's rows across
-    reduce partitions."""
+    reduce partitions. Numpy scalars normalize to their Python value so
+    columnar-sourced keys co-partition with plain ones (np.int64(3) and
+    3 must land in the same bucket)."""
+    if type(key) not in (str, bytes, int, float, bool) and \
+            hasattr(key, "item"):
+        # numpy scalars INCLUDING np.str_/np.bytes_ (their pickle bytes
+        # differ from the plain value's, so crc32 would diverge)
+        try:
+            key = key.item()
+        except (ValueError, AttributeError):
+            pass
     if isinstance(key, int):
         return key
     return zlib.crc32(pickle.dumps(key, protocol=5))
@@ -165,3 +175,65 @@ def groupby_exchange(block_refs, fused, num_partitions, key,
                 for k, rows in sorted(groups.items(), key=lambda kv: kv[0])]
 
     return exchange(block_refs, fused, num_partitions, partitioner, reducer)
+
+
+# ------------------------------------------------------------------ join
+
+
+def join_exchange(left_refs, left_fused, right_refs, right_fused,
+                  num_partitions: int, on: str, how: str = "inner"):
+    """Hash join: both sides co-partition rows by key hash, one reduce
+    task per partition builds a hash table on the right side and probes
+    with the left (reference role: ray.data joins via hash shuffle,
+    _internal/planner/exchange + Dataset.join). `how`: "inner" or
+    "left". Duplicate non-key columns from the right get a "_1"
+    suffix."""
+    import ray_tpu
+
+    P = max(1, num_partitions)
+
+    def make_map(fused):
+        @ray_tpu.remote(num_cpus=1, num_returns=P)
+        def _map(block):
+            from ray_tpu.data.block import to_rows
+
+            buckets: list[list] = [[] for _ in range(P)]
+            for r in to_rows(fused(block)):
+                buckets[_stable_hash(r[on]) % P].append(r)
+            return tuple(buckets) if P > 1 else buckets[0]
+
+        return _map
+
+    @ray_tpu.remote(num_cpus=1)
+    def _join(p, n_left, *parts):
+        left_rows = [r for part in parts[:n_left] for r in part]
+        right_by_key: dict = {}
+        for part in parts[n_left:]:
+            for r in part:
+                right_by_key.setdefault(r[on], []).append(r)
+        out = []
+        for lr in left_rows:
+            matches = right_by_key.get(lr[on])
+            if matches:
+                for rr in matches:
+                    merged = dict(lr)
+                    for k, v in rr.items():
+                        if k == on:
+                            continue
+                        merged[k if k not in merged else k + "_1"] = v
+                    out.append(merged)
+            elif how == "left":
+                out.append(dict(lr))
+        return out
+
+    lmap, rmap = make_map(left_fused), make_map(right_fused)
+    louts = [lmap.remote(ref) for ref in left_refs]
+    routs = [rmap.remote(ref) for ref in right_refs]
+    if P == 1:
+        louts = [[r] for r in louts]
+        routs = [[r] for r in routs]
+    return [
+        _join.remote(p, len(louts),
+                     *[m[p] for m in louts], *[m[p] for m in routs])
+        for p in range(P)
+    ]

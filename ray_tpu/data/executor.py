@@ -139,6 +139,21 @@ class StreamingExecutor:
                 "buffered_bytes": stats.buffered_bytes,
                 "backpressure_waits": stats.backpressure_waits})
 
+        try:
+            yield from self._run_loop(input_refs, submit, policies, stats,
+                                      window, poll, it, exhausted, _pub,
+                                      last_pub)
+        finally:
+            # abandoned iteration (limit(), break, task error) must not
+            # leave a forever-RUNNING record in the dashboard view
+            _pub("FINISHED")
+
+    def _run_loop(self, input_refs, submit, policies, stats, window, poll,
+                  it, exhausted, _pub, last_pub):
+        import time as _t
+
+        import ray_tpu
+
         while not exhausted or window:
             if _t.monotonic() - last_pub > 2.0:
                 last_pub = _t.monotonic()
@@ -183,4 +198,3 @@ class StreamingExecutor:
                 _t.sleep(0.01)
             else:
                 _t.sleep(0.005)
-        _pub("FINISHED")

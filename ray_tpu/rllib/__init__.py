@@ -37,6 +37,12 @@ from ray_tpu.rllib.multi_agent import (
     MultiAgentPPOConfig,
     MultiRLModule,
 )
+from ray_tpu.rllib.ope import (
+    DoublyRobust,
+    ImportanceSampling,
+    OffPolicyEstimator,
+    WeightedImportanceSampling,
+)
 from ray_tpu.rllib.offline import (
     BC,
     BCConfig,
@@ -71,8 +77,12 @@ __all__ = [
     "ConnectorV2",
     "DQN",
     "DQNConfig",
+    "DoublyRobust",
     "DreamerV3",
     "DreamerV3Config",
+    "ImportanceSampling",
+    "OffPolicyEstimator",
+    "WeightedImportanceSampling",
     "EnvRunnerGroup",
     "FlattenObs",
     "FrameStack",

@@ -19,6 +19,7 @@ from ray_tpu.serve.api import (
     delete,
     deployment,
     get_app_handle,
+    grpc_proxy_address,
     proxy_address,
     run,
     shutdown,
@@ -35,6 +36,7 @@ __all__ = [
     "delete",
     "deployment",
     "get_app_handle",
+    "grpc_proxy_address",
     "proxy_address",
     "run",
     "shutdown",

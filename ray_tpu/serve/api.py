@@ -747,6 +747,95 @@ class ProxyActor:
         self._hlock = threading.Lock()
         threading.Thread(target=self._server.serve_forever, daemon=True,
                          name="serve-proxy-http").start()
+        self.grpc_address = self._start_grpc(bind_host, ip)
+
+    def _start_grpc(self, bind_host: str, ip: str) -> str:
+        """gRPC ingress beside HTTP (reference: the per-node gRPC proxy,
+        serve/_private/proxy.py gRPCProxy). Generic bytes-in/bytes-out
+        service — no proto compilation: callers invoke
+        /ray_tpu.serve.Serve/Predict (unary) or /PredictStreaming
+        (server-streaming) with a JSON payload; the target app rides the
+        'application' invocation metadata (reference: gRPC routing by
+        application metadata)."""
+        import json
+        import time as _t
+        from concurrent.futures import ThreadPoolExecutor
+
+        try:
+            import grpc
+        except ImportError:
+            # HTTP-only deployment: the gRPC ingress degrades away
+            self._grpc_server = None
+            return ""
+
+        import ray_tpu
+
+        proxy = self
+
+        def _app(context) -> str:
+            for k, v in (context.invocation_metadata() or ()):
+                if k == "application":
+                    return v or "default"
+            return "default"
+
+        def predict(request: bytes, context):
+            t0 = _t.perf_counter()
+            app = _app(context)
+            status = "OK"
+            try:
+                payload = json.loads(request) if request else None
+                ref = proxy._handle(app).remote(payload)
+                result = ray_tpu.get(ref, timeout=120)
+                return json.dumps({"result": result},
+                                  default=str).encode()
+            except Exception as e:  # noqa: BLE001
+                status = "ERROR"
+                context.abort(grpc.StatusCode.INTERNAL, repr(e))
+            finally:
+                with proxy._stats_lock:
+                    proxy._totals["requests"] += 1
+                    proxy._totals["grpc"] = \
+                        proxy._totals.get("grpc", 0) + 1
+                    if status != "OK":
+                        proxy._totals["errors"] += 1
+                proxy._requests.inc(tags={"app": app, "status":
+                                          f"grpc_{status}"})
+                proxy._latency.observe((_t.perf_counter() - t0) * 1e3,
+                                       tags={"app": app})
+
+        def predict_streaming(request: bytes, context):
+            app = _app(context)
+            with proxy._stats_lock:
+                proxy._totals["grpc"] = proxy._totals.get("grpc", 0) + 1
+                proxy._totals["streamed"] += 1
+            try:
+                payload = json.loads(request) if request else None
+                gen = proxy._handle(app).options(stream=True).remote(
+                    payload)
+                for ref in gen:
+                    item = ray_tpu.get(ref, timeout=120)
+                    yield json.dumps({"result": item},
+                                     default=str).encode()
+            except Exception as e:  # noqa: BLE001
+                context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+        ident = lambda b: b  # bytes pass through untouched  # noqa: E731
+        handler = grpc.method_handlers_generic_handler(
+            "ray_tpu.serve.Serve", {
+                "Predict": grpc.unary_unary_rpc_method_handler(
+                    predict, request_deserializer=ident,
+                    response_serializer=ident),
+                "PredictStreaming": grpc.unary_stream_rpc_method_handler(
+                    predict_streaming, request_deserializer=ident,
+                    response_serializer=ident),
+            })
+        self._grpc_server = grpc.server(
+            ThreadPoolExecutor(max_workers=16,
+                               thread_name_prefix="serve-grpc"))
+        self._grpc_server.add_generic_rpc_handlers((handler,))
+        gport = self._grpc_server.add_insecure_port(f"{bind_host}:0")
+        self._grpc_server.start()
+        return f"{ip}:{gport}"
 
     def _handle(self, app: str) -> DeploymentHandle:
         with self._hlock:
@@ -760,6 +849,9 @@ class ProxyActor:
     def get_address(self) -> str:
         return self.address
 
+    def get_grpc_address(self) -> str:
+        return self.grpc_address
+
     def get_metrics(self) -> dict:
         """Request totals for serve.status()/the state API."""
         import ray_tpu
@@ -769,6 +861,7 @@ class ProxyActor:
         out["inflight"] = self._inflight
         out["node_id"] = ray_tpu.get_runtime_context().node_id.hex()
         out["address"] = self.address
+        out["grpc_address"] = self.grpc_address
         return out
 
     def ping(self) -> str:
@@ -776,6 +869,8 @@ class ProxyActor:
 
     def stop(self) -> bool:
         self._server.shutdown()
+        if getattr(self, "_grpc_server", None) is not None:
+            self._grpc_server.stop(grace=0.5)
         return True
 
 
@@ -823,6 +918,14 @@ def proxy_address() -> str:
 
     proxy = ray_tpu.get_actor(_PROXY_NAME)
     return ray_tpu.get(proxy.get_address.remote(), timeout=30)
+
+
+def grpc_proxy_address() -> str:
+    """The gRPC ingress endpoint (reference: serve's gRPC proxy port)."""
+    import ray_tpu
+
+    proxy = ray_tpu.get_actor(_PROXY_NAME)
+    return ray_tpu.get(proxy.get_grpc_address.remote(), timeout=30)
 
 
 def _iter_proxies():

@@ -12,6 +12,7 @@ from ray_tpu.tune.schedulers import (
     MedianStoppingRule,
     PB2,
     PopulationBasedTraining,
+    ResourceChangingScheduler,
 )
 from ray_tpu.tune.search import (
     Searcher,
@@ -38,6 +39,7 @@ __all__ = [
     "MedianStoppingRule",
     "PB2",
     "PopulationBasedTraining",
+    "ResourceChangingScheduler",
     "ResultGrid",
     "Searcher",
     "TPESearcher",

@@ -320,6 +320,104 @@ class PB2(PopulationBasedTraining):
         return cand
 
 
+class ResourceChangingScheduler:
+    """Reallocate trial resources mid-flight (reference:
+    ray.tune.schedulers.ResourceChangingScheduler,
+    resource_changing_scheduler.py — wraps a base scheduler; a
+    resources_allocation_function decides each trial's new allocation
+    from the population's results). The Tuner acts on the
+    ("REALLOCATE", resources) decision by restarting the trial's actor
+    from its latest checkpoint with the new resource request — the same
+    checkpoint-restart machinery PBT's exploit uses.
+
+    The default allocation function is DistributeResourcesToTopJob-
+    shaped: the current best trial gets `top_cpus`, everyone else
+    `base_cpus`."""
+
+    def __init__(self, base_scheduler=None,
+                 resources_allocation_function=None,
+                 reallocation_interval: int = 4,
+                 time_attr: str = "training_iteration",
+                 base_cpus: float = 1.0, top_cpus: float = 2.0,
+                 metric: str | None = None, mode: str | None = None):
+        self.base = base_scheduler or FIFOScheduler()
+        self.fn = resources_allocation_function
+        self.interval = reallocation_interval
+        self.time_attr = time_attr
+        self.base_cpus = base_cpus
+        self.top_cpus = top_cpus
+        self.metric = metric
+        self.mode = mode
+        self._scores: dict[str, float] = {}
+        self._alloc: dict[str, float] = {}  # current CPUs per trial
+        self._last_realloc: dict[str, int] = {}
+        self.realloc_count = 0
+
+    def set_objective(self, metric: str, mode: str):
+        self.metric = self.metric or metric
+        self.mode = self.mode or mode
+        if hasattr(self.base, "set_objective"):
+            self.base.set_objective(metric, mode)
+
+    def on_trial_add(self, trial_id: str, config: dict):
+        self._alloc.setdefault(trial_id, self.base_cpus)
+        if hasattr(self.base, "on_trial_add"):
+            self.base.on_trial_add(trial_id, config)
+
+    def on_trial_complete(self, trial_id: str):
+        self._scores.pop(trial_id, None)
+        self._alloc.pop(trial_id, None)
+        self.base.on_trial_complete(trial_id)
+
+    def _default_allocation(self, trial_id: str) -> dict | None:
+        if len(self._scores) < 2:
+            return None
+        best = (max if self.mode == "max" else min)(
+            self._scores, key=self._scores.get)
+        want = self.top_cpus if trial_id == best else self.base_cpus
+        if abs(self._alloc.get(trial_id, self.base_cpus) - want) < 1e-9:
+            return None  # unchanged: no restart
+        return {"CPU": want}
+
+    def on_result(self, trial_id: str, result: dict):
+        value = result.get(self.metric)
+        if value is not None:
+            self._scores[trial_id] = float(value)
+        d = self.base.on_result(trial_id, result)
+        if d != CONTINUE:
+            return d
+        t = result.get(self.time_attr)
+        if t is None or \
+                t - self._last_realloc.get(trial_id, 0) < self.interval:
+            return CONTINUE
+        self._last_realloc[trial_id] = t
+        new_res = (self.fn(trial_id, dict(self._scores),
+                           dict(self._alloc))
+                   if self.fn else self._default_allocation(trial_id))
+        if not new_res:
+            return CONTINUE
+        self._pending_realloc = (trial_id,
+                                 self._alloc.get(trial_id, self.base_cpus),
+                                 self._last_realloc[trial_id])
+        self._alloc[trial_id] = new_res.get("CPU", self.base_cpus)
+        self.realloc_count += 1
+        return ("REALLOCATE", new_res)
+
+    def on_realloc_aborted(self, trial_id: str):
+        """The Tuner could not resize (no checkpoint yet): roll back the
+        allocation view and the interval clock so a later report retries
+        instead of believing the resize happened."""
+        pending = getattr(self, "_pending_realloc", None)
+        if pending is not None and pending[0] == trial_id:
+            _, old_alloc, old_t = pending
+            self._alloc[trial_id] = old_alloc
+            # rewind the clock so the next report past the interval
+            # fires again
+            self._last_realloc[trial_id] = old_t - self.interval
+            self.realloc_count -= 1
+            self._pending_realloc = None
+
+
 def _gp_predict(X, y, Xq, lengthscale: float = 0.3, noise: float = 1e-2):
     """RBF-kernel GP posterior mean/std at query points (inputs already
     normalized to [0,1]^d)."""

@@ -468,7 +468,8 @@ class Tuner:
                     d = scheduler.on_result(t.trial_id, m)
                     if d == STOP:
                         decision = STOP
-                    elif isinstance(d, tuple) and d[0] == "EXPLOIT":
+                    elif isinstance(d, tuple) and \
+                            d[0] in ("EXPLOIT", "REALLOCATE"):
                         decision = d
                     if _stop_met(stop_criteria, m):
                         # pin last_result at the stopping report: an
@@ -486,6 +487,35 @@ class Tuner:
                             ray_tpu.get(t.actor.stop.remote(), timeout=30)
                         except Exception:  # noqa: BLE001
                             pass
+                elif isinstance(decision, tuple) and \
+                        decision[0] == "REALLOCATE":
+                    # resource-changing scheduler: restart this trial
+                    # from ITS OWN latest checkpoint with a new resource
+                    # request (reference: resource_changing_scheduler.py
+                    # — the trial pauses and resumes re-sized)
+                    _, new_res = decision
+                    own_ckpt = ckpts.get(t.trial_id)
+                    if own_ckpt is None:
+                        # no checkpoint to resume from yet: tell the
+                        # scheduler so its allocation view rolls back
+                        # and it retries later
+                        if hasattr(scheduler, "on_realloc_aborted"):
+                            scheduler.on_realloc_aborted(t.trial_id)
+                    else:
+                        try:
+                            ray_tpu.kill(t.actor)
+                        except Exception:  # noqa: BLE001
+                            pass
+                        cls_resized = ray_tpu.remote(**{
+                            "num_cpus": new_res.get("CPU", 1.0),
+                            "resources": {k: v for k, v in new_res.items()
+                                          if k != "CPU"},
+                        })(TrialActor)
+                        t.actor = cls_resized.options(
+                            max_concurrency=2).remote(
+                                t.trial_id, fn_blob, t.config, own_ckpt)
+                        t.resources = dict(new_res)
+                        self._save_state(trials)
                 elif isinstance(decision, tuple):
                     # PBT exploit: restart this trial from the source
                     # trial's checkpoint with the mutated config

@@ -1,0 +1,43 @@
+"""Accelerator plugin registry (reference: ray._private.accelerators —
+AcceleratorManager ABC + per-type registry)."""
+
+from ray_tpu import accelerators as acc
+
+
+def test_registry_has_tpu_and_gpu():
+    managers = acc.all_managers()
+    assert managers["TPU"] is acc.TPUAcceleratorManager
+    assert managers["GPU"] is acc.NvidiaGPUAcceleratorManager
+    assert acc.get_manager("TPU").resource_name == "TPU"
+    assert acc.get_manager("nope") is None
+
+
+def test_tpu_env_handoff_roundtrip():
+    env = {"PALLAS_AXON_POOL_IPS": "10.0.0.1", "JAX_PLATFORMS": "axon"}
+    acc.TPUAcceleratorManager.configure_worker_env(env, claimed=False)
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "PALLAS_AXON_POOL_IPS" not in env
+    assert env["RAY_TPU_AXON_POOL_IPS"] == "10.0.0.1"  # parked
+    # a TPU-claiming worker restores the device
+    acc.TPUAcceleratorManager.configure_worker_env(env, claimed=True)
+    assert env["PALLAS_AXON_POOL_IPS"] == "10.0.0.1"
+    assert "JAX_PLATFORMS" not in env
+
+
+def test_detect_node_resources(monkeypatch):
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.delenv("TPU_CHIPS_PER_HOST_BOUNDS", raising=False)
+    assert "TPU" not in acc.detect_node_resources()
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    assert acc.detect_node_resources().get("TPU") == 1.0
+    monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "2,2,1")
+    monkeypatch.setenv("TPU_CHIPS_PER_HOST", "4")
+    assert acc.detect_node_resources().get("TPU") == 4.0
+
+
+def test_gpu_masking():
+    env = {"CUDA_VISIBLE_DEVICES": "0,1"}
+    acc.NvidiaGPUAcceleratorManager.configure_worker_env(env, claimed=False)
+    assert env["CUDA_VISIBLE_DEVICES"] == ""
+    acc.NvidiaGPUAcceleratorManager.configure_worker_env(env, claimed=True)
+    assert "CUDA_VISIBLE_DEVICES" not in env

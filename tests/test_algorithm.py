@@ -310,3 +310,30 @@ def test_pb2_beats_pbt_on_noisy_hill(cluster, tmp_path):
     pbt_best = _run_population(pbt, "hill_pbt", tmp_path, seed=5)
     pb2_best = _run_population(pb2, "hill_pb2", tmp_path, seed=5)
     assert pb2_best > pbt_best, (pb2_best, pbt_best)
+
+
+def test_resource_changing_scheduler(cluster, tmp_path):
+    """The best trial gets more CPUs mid-flight; the trial restarts
+    from its own checkpoint and keeps its iteration clock (reference:
+    ResourceChangingScheduler + DistributeResourcesToTopJob)."""
+    sched = tune.ResourceChangingScheduler(
+        reallocation_interval=3, base_cpus=1.0, top_cpus=2.0)
+    tuner = tune.Tuner(
+        _Quad,
+        param_space={"lr": tune.grid_search([0.05, 0.4])},
+        tune_config=tune.TuneConfig(metric="objective", mode="min",
+                                    scheduler=sched,
+                                    max_concurrent_trials=2),
+        run_config=RunConfig(name="rcs", storage_path=str(tmp_path),
+                             stop={"training_iteration": 14}),
+    )
+    grid = tuner.fit()
+    assert not grid.errors
+    assert sched.realloc_count >= 1
+    best = grid.get_best_result()
+    # iteration clock survived the resize restart
+    assert best.metrics["training_iteration"] == 14
+    assert best.config["lr"] == 0.4
+    # the resized trial actually resumed from its checkpoint
+    restarted = [r for r in grid if r.metrics.get("restored")]
+    assert restarted

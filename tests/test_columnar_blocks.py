@@ -204,3 +204,32 @@ def test_iter_jax_batches_from_columnar(cluster):
     np.testing.assert_array_equal(
         np.concatenate([np.asarray(b["x"]) for b in batches]),
         np.arange(64, dtype=np.float32))
+
+
+def test_join_inner_and_left(cluster):
+    users = rd.from_items([{"uid": i, "name": f"u{i}"} for i in range(8)],
+                          parallelism=3)
+    orders = rd.from_items(
+        [{"uid": i % 4, "amount": 10 * i, "name": f"o{i}"}
+         for i in range(6)], parallelism=2)
+    inner = users.join(orders, on="uid").take_all()
+    assert len(inner) == 6  # every order matches a user (uids 0-3)
+    row = next(r for r in inner if r["amount"] == 50)
+    assert row["uid"] == 1 and row["name"] == "u1" and row["name_1"] == "o5"
+
+    left = users.join(orders, on="uid", how="left").take_all()
+    # users 4..7 have no orders but survive with their own columns
+    unmatched = [r for r in left if r["uid"] >= 4]
+    assert len(unmatched) == 4
+    assert all("amount" not in r for r in unmatched)
+    assert len(left) == 10  # 6 matches + 4 left-only
+
+    # joins compose with pending ops and columnar sources
+    big = rd.from_numpy({"uid": np.arange(8), "score": np.arange(8) * 1.0})
+    j = users.filter(lambda r: r["uid"] < 3).join(big, on="uid")
+    rows = sorted(j.take_all(), key=lambda r: r["uid"])
+    assert [int(r["uid"]) for r in rows] == [0, 1, 2]
+    assert rows[2]["score"] == 2.0
+
+    with pytest.raises(ValueError, match="how must be"):
+        users.join(orders, on="uid", how="outer")

@@ -81,3 +81,22 @@ def test_large_or_framed_oneways_bypass_batching():
     finally:
         client.close()
         server.stop()
+
+
+def test_event_stats_track_handlers_and_lag():
+    """Reference: common/event_stats.h — per-handler duration + queue
+    lag visible on the server."""
+    server = RpcServer(name="stats-test").start()
+    server.register("work", lambda msg, frames: time.sleep(0.02) or {})
+    client = RpcClient()
+    try:
+        for _ in range(3):
+            client.call(server.address, "work", {}, timeout=30)
+        stats = server.event_stats()
+        assert stats["work"]["count"] == 3
+        assert stats["work"]["total_ms"] >= 3 * 20
+        assert stats["work"]["max_ms"] >= 20
+        assert stats["work"]["max_lag_ms"] >= 0
+    finally:
+        client.close()
+        server.stop()

@@ -373,3 +373,56 @@ def test_proxy_fleet_two_nodes_and_state_metrics(cluster):
     for nid in fleet:
         assert by_node[nid]["requests"] >= 1, by_node
     serve.delete("fleet-echo")
+
+
+def test_grpc_ingress_unary_and_streaming(cluster):
+    """gRPC ingress beside HTTP (reference: serve's per-node gRPC
+    proxy): unary Predict and server-streaming PredictStreaming, app
+    routed by 'application' metadata."""
+    import time
+
+    import grpc
+
+    @serve.deployment
+    class G:
+        def __call__(self, body):
+            return {"doubled": (body or 0) * 2}
+
+    @serve.deployment
+    class GS:
+        def __call__(self, body):
+            for i in range(3):
+                yield {"i": i}
+                time.sleep(0.05)
+
+    serve.run(G.bind(), name="gapp")
+    serve.run(GS.bind(), name="gstream")
+    serve.start_proxy(port=0)
+    addr = serve.grpc_proxy_address()
+    channel = grpc.insecure_channel(addr)
+    ident = lambda b: b  # noqa: E731
+    predict = channel.unary_unary("/ray_tpu.serve.Serve/Predict",
+                                  request_serializer=ident,
+                                  response_deserializer=ident)
+    out = json.loads(predict(json.dumps(21).encode(),
+                             metadata=(("application", "gapp"),),
+                             timeout=60))
+    assert out["result"] == {"doubled": 42}
+
+    stream = channel.unary_stream("/ray_tpu.serve.Serve/PredictStreaming",
+                                  request_serializer=ident,
+                                  response_deserializer=ident)
+    chunks = [json.loads(c)["result"] for c in
+              stream(b"null",
+                     metadata=(("application", "gstream"),), timeout=60)]
+    assert chunks == [{"i": 0}, {"i": 1}, {"i": 2}]
+
+    # unknown app surfaces a gRPC error, not a hang
+    with pytest.raises(grpc.RpcError):
+        predict(b"1", metadata=(("application", "nope"),), timeout=30)
+    # grpc requests visible in proxy metrics
+    st = serve.status()
+    assert any(p.get("grpc", 0) >= 3 for p in st["proxies"])
+    channel.close()
+    serve.delete("gapp")
+    serve.delete("gstream")
