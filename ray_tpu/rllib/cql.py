@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ray_tpu.rllib.checkpointable import Checkpointable
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.sac import (_mlp, init_sac_params, q_values,
                                sample_action)
 
@@ -68,13 +68,12 @@ def record_continuous_experiences(env: str, num_steps: int, out_dir: str,
 
 
 @dataclasses.dataclass
-class CQLConfig:
-    """Reference: CQLConfig (cql.py) = SACConfig + conservative knobs."""
+class CQLConfig(AlgorithmConfig):
+    """Reference: CQLConfig (cql.py) = SACConfig + conservative knobs;
+    rides the shared AlgorithmConfig (env = evaluation env)."""
 
     input_path: str = ""
     env: str = "Pendulum-v1"  # evaluation env
-    lr: float = 3e-4
-    gamma: float = 0.99
     tau: float = 0.005
     train_batch_size: int = 256
     updates_per_iteration: int = 32
@@ -84,34 +83,27 @@ class CQLConfig:
     # conservative regularizer (reference: cql.py min_q_weight role)
     cql_alpha: float = 5.0
     n_action_samples: int = 4
-    seed: int = 0
 
     def offline_data(self, input_path: str) -> "CQLConfig":
         self.input_path = input_path
-        return self
-
-    def environment(self, env: str) -> "CQLConfig":
-        self.env = env
-        return self
-
-    def training(self, **kw) -> "CQLConfig":
-        for k, v in kw.items():
-            if not hasattr(self, k):
-                raise ValueError(f"unknown option {k!r}")
-            setattr(self, k, v)
         return self
 
     def build(self) -> "CQL":
         return CQL(self)
 
 
-class CQL(Checkpointable):
-    STATE_COMPONENTS = ("params", "target_q", "log_alpha", "_iteration")
+class CQL(Algorithm):
+    """Conservative Q-learning on the shared Algorithm base (offline:
+    no sampling env; `evaluate(...)` if present takes the env
+    explicitly)."""
 
-    def __init__(self, config: CQLConfig):
+    config_class = CQLConfig
+    STATE_COMPONENTS = ("params", "target_q", "log_alpha", "_iteration",
+                        "_timesteps_total")
+
+    def setup(self, config: CQLConfig):
         from ray_tpu.rllib.offline import load_offline_dataset
 
-        self.config = config
         cfg = config
         rows = load_offline_dataset(cfg.input_path).take_all()
         if not rows:
@@ -224,14 +216,13 @@ class CQL(Checkpointable):
         self._update = jax.jit(update)
         self._key = jax.random.PRNGKey(cfg.seed + 1)
         self._rng = np.random.default_rng(cfg.seed)
-        self._iteration = 0
 
     def _minibatch(self):
         n = len(self._data["rewards"])
         idx = self._rng.integers(0, n, min(self.config.train_batch_size, n))
         return {k: jnp.asarray(v[idx]) for k, v in self._data.items()}
 
-    def train(self) -> dict:
+    def training_step(self) -> dict:
         cfg = self.config
         t0 = time.perf_counter()
         bellmans, gaps, a_losses = [], [], []
@@ -244,9 +235,7 @@ class CQL(Checkpointable):
             bellmans.append(float(bell))
             gaps.append(float(gap))
             a_losses.append(float(al))
-        self._iteration += 1
         return {
-            "training_iteration": self._iteration,
             "learner/bellman_loss": float(np.mean(bellmans)),
             "learner/conservative_gap": float(np.mean(gaps)),
             "learner/actor_loss": float(np.mean(a_losses)),

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ray_tpu.rllib.checkpointable import Checkpointable
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 
 # ------------------------------------------------------------ symlog/twohot
 # Reference: utils/symlog used throughout DreamerV3 (predict in a
@@ -151,9 +151,10 @@ class EpisodeSequenceBuffer:
 
 
 @dataclasses.dataclass
-class DreamerV3Config:
+class DreamerV3Config(AlgorithmConfig):
     """Reference: DreamerV3Config (dreamerv3.py) — the two knobs that
-    matter are model_size and training_ratio."""
+    matter are model_size and training_ratio; rides the shared
+    AlgorithmConfig so DreamerV3 runs as a Tune trial."""
 
     env: str = "CartPole-v1"
     model_size: str = "XS"  # XS | S (test scale; larger follow the table)
@@ -171,18 +172,6 @@ class DreamerV3Config:
     buffer_capacity: int = 100_000
     num_envs: int = 4
     rollout_fragment_length: int = 16
-    seed: int = 0
-
-    def environment(self, env: str) -> "DreamerV3Config":
-        self.env = env
-        return self
-
-    def training(self, **kw) -> "DreamerV3Config":
-        for k, v in kw.items():
-            if not hasattr(self, k):
-                raise ValueError(f"unknown option {k!r}")
-            setattr(self, k, v)
-        return self
 
     def dims(self):
         # reference model-size table (dreamerv3.py): deter/units scale
@@ -198,14 +187,19 @@ class DreamerV3Config:
 # ------------------------------------------------------------ algorithm
 
 
-class DreamerV3(Checkpointable):
+class DreamerV3(Algorithm):
+    config_class = DreamerV3Config
     STATE_COMPONENTS = ("wm", "actor", "critic", "critic_ema",
-                        "_env_steps", "_iteration")
+                        "_env_steps", "_iteration", "_timesteps_total")
 
-    def __init__(self, config: DreamerV3Config):
+    def setup(self, config: DreamerV3Config):
+        if config.evaluation_interval:
+            raise ValueError(
+                "DreamerV3 has no separate evaluation runner — "
+                "episode_return_mean from training IS the "
+                "evaluation surface; unset evaluation_interval")
         import gymnasium as gym
 
-        self.config = config
         cfg = config
         d = cfg.dims()
         deter, units = d["deter"], d["units"]
@@ -266,7 +260,6 @@ class DreamerV3(Checkpointable):
         self._completed: list[float] = []
         self._env_steps = 0
         self._replayed = 0
-        self._iteration = 0
         self._build_fns(deter, stoch, A)
 
     # -------------------------------------------------------------- fns
@@ -512,7 +505,7 @@ class DreamerV3(Checkpointable):
 
     # ------------------------------------------------------------ train
 
-    def train(self) -> dict:
+    def training_step(self) -> dict:
         cfg = self.config
         t0 = time.perf_counter()
         # -- collect real experience through the posterior policy
@@ -566,11 +559,9 @@ class DreamerV3(Checkpointable):
             metrics = {k2: float(v) for k2, v in m.items()}
             self._replayed += cfg.batch_size_B * cfg.batch_length_T
 
-        self._iteration += 1
         window = self._completed[-100:]
         self._completed = window
         return {
-            "training_iteration": self._iteration,
             "episode_return_mean": float(np.mean(window)) if window
             else float("nan"),
             "num_env_steps_sampled_lifetime": self._env_steps,
@@ -579,5 +570,15 @@ class DreamerV3(Checkpointable):
             **metrics,
         }
 
-    def stop(self):
+    def get_weights(self):
+        return jax.tree.map(np.asarray, {"wm": self.wm, "actor": self.actor,
+                                         "critic": self.critic})
+
+    def evaluate(self) -> dict:
+        # Dreamer's env loop lives in the driver with its own buffer —
+        # episode_return_mean from training is the evaluation surface
+        raise NotImplementedError(
+            "DreamerV3 evaluation rides episode_return_mean from training")
+
+    def cleanup(self):
         self.envs.close()

@@ -20,6 +20,7 @@ import numpy as np
 import optax
 
 from ray_tpu.rllib import models
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 
 
 def record_experiences(env: str, num_episodes: int, out_dir: str,
@@ -88,31 +89,22 @@ def load_offline_dataset(path: str):
 
 
 @dataclasses.dataclass
-class BCConfig:
+class BCConfig(AlgorithmConfig):
     """Reference: rllib/algorithms/bc/bc.py — supervised action
-    cloning on logged states."""
+    cloning on logged states; rides the shared AlgorithmConfig so BC
+    runs as a Tune trial like the online families."""
 
     input_path: str = ""
     lr: float = 1e-3
     train_batch_size: int = 256
-    hidden: tuple = (64, 64)
     # MARWIL generalization (marwil.py): beta > 0 weights the cloning
     # loss by exp(beta * advantage) where advantage is the discounted
     # return minus a learned value baseline; beta = 0 is plain BC.
     beta: float = 0.0
-    gamma: float = 0.99
     vf_coeff: float = 1.0
-    seed: int = 0
 
     def offline_data(self, input_path: str) -> "BCConfig":
         self.input_path = input_path
-        return self
-
-    def training(self, **kw) -> "BCConfig":
-        for k, v in kw.items():
-            if not hasattr(self, k):
-                raise ValueError(f"unknown option {k!r}")
-            setattr(self, k, v)
         return self
 
     def build(self) -> "BC":
@@ -127,12 +119,17 @@ class MARWILConfig(BCConfig):
         return BC(self)
 
 
-class BC:
-    """Behavior cloning / MARWIL driver: one jitted supervised update
-    per minibatch over the offline dataset."""
+class BC(Algorithm):
+    """Behavior cloning / MARWIL driver on the shared Algorithm base:
+    one jitted supervised update per minibatch over the offline
+    dataset. `evaluate(env, ...)` takes the env EXPLICITLY (offline
+    algos carry no sampling env in the config)."""
 
-    def __init__(self, config: BCConfig):
-        self.config = config
+    config_class = BCConfig
+    STATE_COMPONENTS = ("params", "opt_state", "_iteration",
+                        "_timesteps_total")
+
+    def setup(self, config: BCConfig):
         rows = load_offline_dataset(config.input_path).take_all()
         if not rows:
             raise ValueError(f"no offline rows at {config.input_path!r}")
@@ -185,9 +182,8 @@ class BC:
 
         self._update = jax.jit(update, donate_argnums=(0, 1))
         self._rng = np.random.RandomState(config.seed)
-        self._iteration = 0
 
-    def train(self) -> dict:
+    def training_step(self) -> dict:
         cfg = self.config
         n = len(self._data["actions"])
         t0 = time.perf_counter()
@@ -201,17 +197,18 @@ class BC:
             self.params, self.opt_state, loss = self._update(
                 self.params, self.opt_state, batch)
             losses.append(float(loss))
-        self._iteration += 1
         return {
-            "training_iteration": self._iteration,
             "learner/loss": float(np.mean(losses)),
             "num_samples": n,
             "time_s": time.perf_counter() - t0,
         }
 
-    def evaluate(self, env: str, num_episodes: int = 20) -> dict:
+    def evaluate(self, env: str | None = None,
+                 num_episodes: int = 20) -> dict:
         """Greedy rollout of the cloned policy (reference: BC eval via
-        evaluation env runners)."""
+        evaluation env runners). `env` defaults to config.env so the
+        base Algorithm.step() evaluation hook works too."""
+        env = env or self.config.env
         import gymnasium as gym
 
         from ray_tpu.rllib import envs as _envs

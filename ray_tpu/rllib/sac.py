@@ -95,6 +95,11 @@ class SAC(Algorithm):
                         "_env_steps", "_iteration", "_timesteps_total")
 
     def setup(self, config: SACConfig):
+        if config.evaluation_interval:
+            raise ValueError(
+                "SAC has no separate evaluation runner — "
+                "episode_return_mean from training IS the "
+                "evaluation surface; unset evaluation_interval")
         import gymnasium as gym
 
         cfg = config
