@@ -113,7 +113,10 @@ class _ClientHost:
         import ray_tpu
 
         refs = [self._decode(r) for r in msg["refs"]]
-        # always a list in, list out; the thin client unwraps singles
+        # always a list in, list out; the thin client unwraps singles.
+        # Blocking here is the proxy's job: c_get rides the slow lane
+        # (registered slow=True), and task_done lands on the main pool.
+        # graftlint: disable=async-blocking
         values = ray_tpu.get(refs, timeout=msg.get("timeout", 300))
         head, views, total = ser.serialize(values)
         buf = bytearray(total)
@@ -125,6 +128,8 @@ class _ClientHost:
 
         refs = [self._decode(r) for r in msg["refs"]]
         by_id = {r.id.binary(): m for r, m in zip(refs, msg["refs"])}
+        # synchronous proxy on the slow lane, same rationale as c_get
+        # graftlint: disable=async-blocking
         ready, pending = ray_tpu.wait(
             refs, num_returns=msg.get("num_returns", 1),
             timeout=msg.get("timeout"))

@@ -154,45 +154,49 @@ class ClusterRuntime:
         self._events = TaskEventLog()
         self.client = RpcClient.shared()
         self._lock = threading.RLock()
-        self._owned: dict[bytes, _Owned] = {}
-        self._refcounts: dict[bytes, int] = {}
-        self._fn_cache: dict[str, Callable] = {}
-        self._exported_fns: set[str] = set()
+        self._owned: dict[bytes, _Owned] = {}  # guarded_by(_lock)
+        self._refcounts: dict[bytes, int] = {}  # guarded_by(_lock)
+        self._fn_cache: dict[str, Callable] = {}  # guarded_by(_lock)
+        self._exported_fns: set[str] = set()  # guarded_by(_lock)
         import weakref
 
         self._fn_id_cache = weakref.WeakKeyDictionary()  # fn -> fn_id
-        self._actor_addr: dict[bytes, str] = {}
-        self._actor_meta: dict[bytes, dict] = {}
+        self._actor_addr: dict[bytes, str] = {}  # guarded_by(_lock)
+        self._actor_meta: dict[bytes, dict] = {}  # guarded_by(_lock)
         # in-flight actor calls by actor: when an actor dies/restarts, its
         # pending calls must fail fast with ActorDiedError instead of
         # leaving the owner waiting forever (reference: ActorTaskSubmitter
         # DisconnectActor fails inflight tasks, actor_task_submitter.h:75)
-        self._inflight_actor: dict[bytes, dict[bytes, list[bytes]]] = {}
-        self._task_actor: dict[bytes, bytes] = {}  # task_id -> actor_id
-        # objects we borrow (store bytes owned elsewhere): oid -> owner
+        self._inflight_actor: dict[bytes, dict[bytes, list[bytes]]] = {}  # guarded_by(_lock)
+        # task_id -> actor_id; guarded_by(_lock)
+        self._task_actor: dict[bytes, bytes] = {}
+        # objects we borrow (store bytes owned elsewhere): oid -> owner;
+        # guarded_by(_lock)
         self._borrowed_owner: dict[bytes, str] = {}
         # oid -> epoch of the ACTIVE borrow lifecycle (popped on release
         # so the dict never outgrows the live borrow set); epochs come
         # from one global monotonic counter so a re-borrow always
         # outranks any earlier queued release
-        self._borrow_epoch: dict[bytes, int] = {}
-        self._borrow_epoch_counter = 0
+        self._borrow_epoch: dict[bytes, int] = {}  # guarded_by(_lock)
+        self._borrow_epoch_counter = 0  # guarded_by(_lock)
         self._rtenv_cache: dict = {}  # normalized runtime envs by content
         # Store buffers pinned because a deserialized object graph aliases
         # them zero-copy (plasma pin semantics); released when the owning
         # object is freed or at shutdown.
-        self._pins: dict[bytes, memoryview] = {}
+        self._pins: dict[bytes, memoryview] = {}  # guarded_by(_lock)
         # Refs riding as args of in-flight tasks hold a reference until
         # the task reaches a terminal state (reference: TaskManager
         # "submitted task references", core_worker/task_manager.h:212).
-        self._task_arg_refs: dict[bytes, list[bytes]] = {}
+        self._task_arg_refs: dict[bytes, list[bytes]] = {}  # guarded_by(_lock)
         self._booted = []  # in-process services we own (head/nodelet)
         self._shutdown_flag = False
         # worker-lease reuse + pipelined submission state
-        self._lease_pools: dict[tuple, list] = {}  # key -> [_HeldLease]
-        self._lease_pending: dict[tuple, list] = {}  # key -> [TaskSpec]
-        self._task_lease: dict[bytes, tuple] = {}  # task_id -> (lease, spec)
-        # in-flight submission acks: [deadline, future, resend_fn, fail_fn]
+        self._lease_pools: dict[tuple, list] = {}  # guarded_by(_lock)
+        self._lease_pending: dict[tuple, list] = {}  # guarded_by(_lock)
+        # task_id -> (lease, spec); guarded_by(_lock)
+        self._task_lease: dict[bytes, tuple] = {}
+        # in-flight submission acks: [deadline, future, resend_fn,
+        # fail_fn]; guarded_by(_lock)
         self._pending_acks: list = []
         # gc-driven oneways (frees/borrow releases) flushed by the sweeper
         from collections import deque as _deque
@@ -202,12 +206,12 @@ class ClusterRuntime:
         # hold, not this process's cores — nodelet denials (with 50ms
         # negative caching) are the real admission control
         self._lease_cap = 64
-        self._lease_backoff: dict[tuple, float] = {}  # key -> retry-after
+        self._lease_backoff: dict[tuple, float] = {}  # guarded_by(_lock)
         self._last_renew = 0.0
         self._last_backlog = 0
 
         # streaming-generator streams we own, keyed by producing task_id
-        self._streams: dict[bytes, _StreamState] = {}
+        self._streams: dict[bytes, _StreamState] = {}  # guarded_by(_lock)
         self.server = RpcServer(name=f"rt-{mode}", num_threads=32)
         self.server.register("lease_broken", self._h_lease_broken,
                              oneway=True)
@@ -1341,12 +1345,17 @@ class ClusterRuntime:
             return fn_id
         blob = cloudpickle.dumps(fn)
         fn_id = hashlib.sha1(blob).hexdigest()
-        if fn_id not in self._exported_fns:
+        with self._lock:
+            exported = fn_id in self._exported_fns
+        if not exported:
+            # off-lock RPC; a racing duplicate kv_put is idempotent
+            # (overwrite=False, content-addressed key)
             self.client.call(self.head_address, "kv_put",
                              {"ns": "fn", "key": fn_id, "overwrite": False},
                              frames=[blob], timeout=30, retries=2)
-            self._exported_fns.add(fn_id)
-            self._fn_cache[fn_id] = fn
+            with self._lock:
+                self._exported_fns.add(fn_id)
+                self._fn_cache[fn_id] = fn
         try:
             self._fn_id_cache[fn] = fn_id
         except TypeError:
@@ -1354,7 +1363,8 @@ class ClusterRuntime:
         return fn_id
 
     def _fetch_fn(self, fn_id: str) -> Callable:
-        fn = self._fn_cache.get(fn_id)
+        with self._lock:
+            fn = self._fn_cache.get(fn_id)
         if fn is None:
             value, frames = self.client.call_frames(
                 self.head_address, "kv_get", {"ns": "fn", "key": fn_id},
@@ -1362,7 +1372,9 @@ class ClusterRuntime:
             if not value.get("found"):
                 raise exc.RayTpuError(f"function {fn_id} not found in KV")
             fn = cloudpickle.loads(frames[0])
-            self._fn_cache[fn_id] = fn
+            with self._lock:
+                # keep the first deserialization a racing fetch cached
+                fn = self._fn_cache.setdefault(fn_id, fn)
         return fn
 
     def _encode_args(self, args, kwargs):

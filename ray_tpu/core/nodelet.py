@@ -129,28 +129,32 @@ class Nodelet:
         self.address = self.server.address
 
         self._lock = threading.RLock()
-        self._available = dict(self.resources)
-        self._queue: deque[TaskSpec] = deque()
+        self._available = dict(self.resources)  # guarded_by(_lock)
+        self._queue: deque[TaskSpec] = deque()  # guarded_by(_lock)
         # resources demanded by queued (not yet dispatched) non-PG tasks:
         # _place must see them or a submission burst that outraces the
         # dispatch thread all lands locally instead of spilling
-        self._queued_demand: dict[str, float] = {}
-        self._enqueue_time: dict[bytes, float] = {}  # task_id -> queued at
-        self._workers: dict[bytes, _Worker] = {}
-        self._idle_workers: deque[_Worker] = deque()
-        self._bundles: dict[tuple, dict] = {}  # (pg_id, idx) -> reserved
-        self._bundle_free: dict[tuple, dict] = {}  # (pg_id, idx) -> remaining
-        self._leases: dict[bytes, _Lease] = {}  # lease_id -> lease
+        self._queued_demand: dict[str, float] = {}  # guarded_by(_lock)
+        # task_id -> queued at; guarded_by(_lock)
+        self._enqueue_time: dict[bytes, float] = {}
+        self._workers: dict[bytes, _Worker] = {}  # guarded_by(_lock)
+        self._idle_workers: deque[_Worker] = deque()  # guarded_by(_lock)
+        # (pg_id, idx) -> reserved; guarded_by(_lock)
+        self._bundles: dict[tuple, dict] = {}
+        # (pg_id, idx) -> remaining; guarded_by(_lock)
+        self._bundle_free: dict[tuple, dict] = {}
+        self._leases: dict[bytes, _Lease] = {}  # lease_id; guarded_by(_lock)
         # bounded concurrent inbound object pulls (pull admission control)
         self._pull_sem = threading.BoundedSemaphore(4)
-        self._pull_waiters = 0
+        self._pull_waiters = 0  # guarded_by(_lock)
         # submitter-reported pipelined backlog: owner -> (expiry, count).
         # Feeds the heartbeat queue_len so the autoscaler sees demand that
         # never materializes as nodelet-queued tasks.
-        self._lease_demand: dict[str, tuple[float, int]] = {}
-        self._cluster_view = []
-        self._view_ts = 0.0
-        self._pull_chunks_served = 0  # chunked-transfer observability
+        self._lease_demand: dict[str, tuple[float, int]] = {}  # guarded_by(_lock)
+        self._cluster_view = []  # guarded_by(_lock)
+        self._view_ts = 0.0  # guarded_by(_lock)
+        # chunked-transfer observability; guarded_by(_lock)
+        self._pull_chunks_served = 0
         self._stopped = threading.Event()
         self._dispatch_wake = threading.Event()
         # At-least-once RPC dedup: schedule_task may be retried by a
@@ -158,8 +162,8 @@ class Nodelet:
         # same TaskSpec twice duplicates side effects. Keyed by
         # (task_id, attempt, spillback_count) so legitimate retries and
         # respill hops pass. Bounded FIFO eviction.
-        self._seen_tasks: set[tuple] = set()
-        self._seen_tasks_order: deque[tuple] = deque()
+        self._seen_tasks: set[tuple] = set()  # guarded_by(_lock)
+        self._seen_tasks_order: deque[tuple] = deque()  # guarded_by(_lock)
         # Worker-pool cap (reference: WorkerPool caps by cores,
         # raylet/worker_pool.h:216). Actors get dedicated processes and
         # are gated by resources instead.
@@ -169,9 +173,9 @@ class Nodelet:
                                              (os.cpu_count() or 8))))
         # spawns in flight (lease path): counted against the cap so N
         # concurrent lease requests can't all pass the check and overshoot
-        self._pending_spawns = 0
-        self._last_memory_check = 0.0
-        self._oom_kills = 0  # observability: surfaced in node_info
+        self._pending_spawns = 0  # guarded_by(_lock)
+        self._last_memory_check = 0.0  # reap thread only
+        self._oom_kills = 0  # surfaced in node_info; guarded_by(_lock)
 
         s = self.server
         s.register("schedule_task", self._h_schedule_task)
@@ -723,7 +727,7 @@ class Nodelet:
         w = victim.worker
         with self._lock:
             w.oom_kill_retry = bool(should_retry)
-        self._oom_kills += 1
+            self._oom_kills += 1
         _log.warning(
             "memory pressure: %.1f%% used (threshold %.0f%%); killing "
             "worker %s (rss=%dMB, policy=%s, retry=%s)",
@@ -900,17 +904,24 @@ class Nodelet:
 
     def _cluster_view_cached(self):
         now = time.monotonic()
-        if now - self._view_ts > 1.0:
-            try:
-                view = self.client.call(self.head_address, "cluster_view", {},
-                                        timeout=5)
-                self._cluster_view = view["nodes"]
-                self._view_ts = now
-            except Exception:
-                pass
-        return self._cluster_view
+        with self._lock:
+            view, ts = self._cluster_view, self._view_ts
+        if now - ts <= 1.0:
+            return view
+        # the view RPC stays OFF the lock: dispatch + handler threads
+        # race here and the loser's slightly-staler view is harmless
+        try:
+            resp = self.client.call(self.head_address, "cluster_view", {},
+                                    timeout=5)
+        except Exception:
+            return view
+        with self._lock:
+            self._cluster_view = resp["nodes"]
+            self._view_ts = now
+            return self._cluster_view
 
     def _add_queued_demand(self, spec: TaskSpec, sign: int):
+        """Caller holds self._lock (every enqueue/dequeue site does)."""
         if spec.placement_group is not None:
             return  # PG tasks are metered against their bundle
         for r, q in spec.resources.items():
@@ -1424,7 +1435,8 @@ class Nodelet:
                     raise RuntimeError(value.get("error", "pull failed"))
                 buf[off:off + n] = frames_in[0]
                 off += n
-                self._pull_chunks_served += 1
+                with self._lock:
+                    self._pull_chunks_served += 1
         except Exception as e:  # noqa: BLE001
             del buf
             try:

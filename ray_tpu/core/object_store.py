@@ -213,8 +213,12 @@ class SegmentPerObjectStore:
 
     def __init__(self, name: str | None = None, capacity: int = 0, create: bool = True):
         self.name = name or f"rts_{secrets.token_hex(6)}"
-        self._held: dict[bytes, ShmSegment] = {}
-        self._unsealed: dict[bytes, ShmSegment] = {}
+        # RPC handler threads (fetch/pull/free) hit one store instance
+        # concurrently; the native path is locked in C, this fallback
+        # must lock its segment tables itself
+        self._lock = threading.Lock()
+        self._held: dict[bytes, ShmSegment] = {}  # guarded_by(_lock)
+        self._unsealed: dict[bytes, ShmSegment] = {}  # guarded_by(_lock)
         self._owner = create
 
     def _seg_name(self, oid: bytes) -> str:
@@ -228,15 +232,20 @@ class SegmentPerObjectStore:
                          size=max(1, size) + self._HDR)
         seg.buf[0] = 0  # unsealed
         seg.buf[8:16] = size.to_bytes(8, "little")
-        self._unsealed[oid] = seg
+        with self._lock:
+            self._unsealed[oid] = seg
         return seg.buf[self._HDR:self._HDR + size]
 
     def seal(self, oid: bytes):
-        seg = self._unsealed.pop(oid, None)
-        if seg is None:
-            raise KeyError(f"seal: no unsealed object {oid.hex()}")
-        seg.buf[0] = 1
-        self._held[oid] = seg
+        # one critical section: a pop/insert gap would let a racing
+        # delete() miss the object (leaking its shm file) and a racing
+        # get() attach a duplicate segment this assignment clobbers
+        with self._lock:
+            seg = self._unsealed.pop(oid, None)
+            if seg is None:
+                raise KeyError(f"seal: no unsealed object {oid.hex()}")
+            seg.buf[0] = 1
+            self._held[oid] = seg
 
     def put(self, oid: bytes, data) -> None:
         data = memoryview(data).cast("B")
@@ -245,15 +254,19 @@ class SegmentPerObjectStore:
         self.seal(oid)
 
     def get(self, oid: bytes) -> memoryview | None:
-        if oid in self._unsealed:
-            return None
-        seg = self._held.get(oid)
+        with self._lock:
+            if oid in self._unsealed:
+                return None
+            seg = self._held.get(oid)
         if seg is None:
             try:
                 seg = ShmSegment(self._seg_name(oid), create=False)
             except FileNotFoundError:
                 return None
-            self._held[oid] = seg
+            with self._lock:
+                # a racing get may have attached too; keep the winner so
+                # the loser's mapping dies with its local reference
+                seg = self._held.setdefault(oid, seg)
         if seg.buf[0] != 1:  # not sealed yet
             return None
         size = int.from_bytes(bytes(seg.buf[8:16]), "little")
@@ -266,7 +279,8 @@ class SegmentPerObjectStore:
         pass
 
     def delete(self, oid: bytes):
-        seg = self._held.pop(oid, None)
+        with self._lock:
+            seg = self._held.pop(oid, None)
         if seg is not None:
             try:
                 seg.close()

@@ -1,0 +1,28 @@
+"""graftlint — project-specific static analysis for the ray_tpu runtime.
+
+The distributed runtime's correctness rests on invariants that unit
+tests cannot cheaply cover: actor event loops must never block on their
+own futures (the classic get-in-async-actor deadlock), SPMD-traced code
+must stay replica-deterministic and free of hidden host transfers, and
+shared nodelet/runtime state must only mutate under its lock. graftlint
+turns those invariants into lint rules that run on every PR.
+
+Layout:
+- ``findings.py``  — the Finding record + stable fingerprints
+- ``registry.py``  — Rule base class + plugin registry
+- ``context.py``   — per-module analysis context (imports, stacks,
+  suppression comments)
+- ``driver.py``    — the single-pass AST walker that feeds every rule
+- ``baseline.py``  — committed-baseline load/save/diff for burn-down
+- ``lint.py``      — CLI: ``python -m ray_tpu.devtools.lint ray_tpu/``
+- ``rules/``       — one module per rule; importing the package
+  registers them
+
+See DEVTOOLS.md at the repo root for the rule catalog and the
+suppression/baseline workflow.
+"""
+
+from ray_tpu.devtools.findings import Finding
+from ray_tpu.devtools.registry import Rule, all_rules, register
+
+__all__ = ["Finding", "Rule", "all_rules", "register"]

@@ -1,0 +1,69 @@
+"""Committed-baseline support for incremental burn-down.
+
+A baseline is a JSON file mapping finding fingerprints to a small
+descriptive record. Findings whose fingerprint appears in the baseline
+are reported as "baselined" and do not fail the run; new findings do.
+The workflow:
+
+- ``python -m ray_tpu.devtools.lint ray_tpu/ --write-baseline`` freezes
+  the current findings (ideally after fixing everything fixable — the
+  committed baseline in this repo is empty and should stay that way).
+- Fixing a baselined finding silently shrinks the effective baseline;
+  ``--prune-baseline`` rewrites the file without the fixed entries.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ray_tpu.devtools.findings import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = "graftlint.baseline.json"
+
+
+def load(path: str) -> dict[str, dict]:
+    if not path or not os.path.isfile(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"unsupported baseline version {data.get('version')!r} "
+            f"in {path}")
+    return data.get("findings", {})
+
+
+def save(path: str, findings: list[Finding]) -> None:
+    entries = {
+        f.fingerprint(): {"rule": f.rule, "code": f.code, "path": f.path,
+                          "line": f.line, "message": f.message}
+        for f in findings
+    }
+    data = {"version": BASELINE_VERSION, "findings": entries}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def split(findings: list[Finding], baseline: dict[str, dict]
+          ) -> tuple[list[Finding], list[Finding]]:
+    """(new, baselined) partition of a run's findings."""
+    new, old = [], []
+    for f in findings:
+        (old if f.fingerprint() in baseline else new).append(f)
+    return new, old
+
+
+def prune(path: str, findings: list[Finding]) -> int:
+    """Drop baseline entries no longer reported. Returns #removed."""
+    baseline = load(path)
+    live = {f.fingerprint() for f in findings}
+    stale = [fp for fp in baseline if fp not in live]
+    if stale:
+        kept = [f for f in findings if f.fingerprint() in baseline]
+        save(path, kept)
+    return len(stale)

@@ -1,0 +1,146 @@
+"""Per-module analysis context shared by all rules during one pass.
+
+Owns everything rules need beyond the current node: the source lines,
+import alias table, enclosing class/function stacks, the set of lock
+expressions held by enclosing ``with`` blocks, and the suppression
+comments (``# graftlint: disable=<rule>[,<rule>...]`` on the offending
+line or on a standalone comment line directly above it;
+``# graftlint: disable-file=<rule>`` anywhere disables for the whole
+file; ``all`` matches every rule).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from typing import TYPE_CHECKING
+
+from ray_tpu.devtools.findings import Finding
+
+if TYPE_CHECKING:
+    from ray_tpu.devtools.registry import Rule
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+
+def qualname(node: ast.AST) -> str | None:
+    """Dotted name of a Name/Attribute chain ('self._lock',
+    'np.random.seed'), or None for anything dynamic."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ModuleContext:
+    def __init__(self, path: str, rel_path: str, source: str,
+                 tree: ast.Module):
+        self.path = path
+        self.rel_path = rel_path.replace("\\", "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.findings: list[Finding] = []
+        self.class_stack: list[ast.ClassDef] = []
+        self.func_stack: list[ast.FunctionDef | ast.AsyncFunctionDef] = []
+        self.lock_stack: list[str] = []  # qualnames of held with-contexts
+        # local alias -> dotted origin ("np" -> "numpy",
+        # "get" -> "ray_tpu.get")
+        self.imports: dict[str, str] = {}
+        self._suppress_line: dict[int, set[str]] = {}
+        self._suppress_file: set[str] = set()
+        self._scan_suppressions()
+
+    # -------------------------------------------------------- suppressions
+
+    def _scan_suppressions(self) -> None:
+        # real COMMENT tokens only: a directive inside a string literal
+        # (a lint test fixture, a doc example) must not suppress anything
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.source).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return  # the parse-error finding already covers this file
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            i = tok.start[0]
+            names = {r.strip() for r in m.group("rules").split(",")}
+            if m.group("file"):
+                self._suppress_file |= names
+            else:
+                self._suppress_line.setdefault(i, set()).update(names)
+                if self.lines[i - 1].lstrip().startswith("#"):
+                    # standalone comment line: also covers the next line
+                    self._suppress_line.setdefault(i + 1, set()).update(names)
+
+    def is_suppressed(self, rule: "Rule", line: int) -> bool:
+        for names in (self._suppress_file,
+                      self._suppress_line.get(line, ())):
+            if names and ("all" in names or rule.name in names
+                          or rule.code in names):
+                return True
+        return False
+
+    # -------------------------------------------------------- reporting
+
+    def report(self, rule: "Rule", node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        if self.is_suppressed(rule, line):
+            return
+        text = self.lines[line - 1] if 0 < line <= len(self.lines) else ""
+        self.findings.append(Finding(
+            path=self.rel_path, line=line, col=col, rule=rule.name,
+            code=rule.code, message=message, line_text=text))
+
+    # -------------------------------------------------------- imports
+
+    def track_import(self, node: ast.Import | ast.ImportFrom) -> None:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                self.imports[a.asname or a.name.split(".")[0]] = a.name
+        else:
+            mod = node.module or ""
+            for a in node.names:
+                self.imports[a.asname or a.name] = (
+                    f"{mod}.{a.name}" if mod else a.name)
+
+    def resolve(self, name: str) -> str:
+        """Fully-qualified origin of a (possibly dotted) local name,
+        following the import table one step: 'np.random.seed' ->
+        'numpy.random.seed'."""
+        head, _, rest = name.partition(".")
+        origin = self.imports.get(head, head)
+        return f"{origin}.{rest}" if rest else origin
+
+    def resolve_call(self, node: ast.Call) -> str | None:
+        qn = qualname(node.func)
+        return self.resolve(qn) if qn else None
+
+    # -------------------------------------------------------- stacks
+
+    @property
+    def current_function(self):
+        return self.func_stack[-1] if self.func_stack else None
+
+    @property
+    def current_class(self):
+        return self.class_stack[-1] if self.class_stack else None
+
+    def in_async_function(self) -> bool:
+        return isinstance(self.current_function, ast.AsyncFunctionDef)
+
+    def holds_lock(self, lock: str) -> bool:
+        return lock in self.lock_stack

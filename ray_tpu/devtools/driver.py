@@ -1,0 +1,131 @@
+"""The single-pass AST driver.
+
+One recursive walk per module; every registered rule observes every
+node in pre-order while the context keeps the class/function/lock
+stacks honest. ``with`` blocks get special treatment: the context
+expressions are visited OUTSIDE the held-lock scope, the body inside —
+that is what lets the guarded-by rule see exactly which lock
+expressions protect a mutation.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from ray_tpu.devtools.context import ModuleContext, qualname
+from ray_tpu.devtools.findings import Finding, assign_occurrences
+from ray_tpu.devtools.registry import Rule
+
+
+def _dispatch_table(rules: list[Rule]) -> tuple[dict, list[Rule]]:
+    """(node-type -> interested rules, rules interested in everything)."""
+    by_type: dict[type, list[Rule]] = {}
+    catch_all: list[Rule] = []
+    for r in rules:
+        if not r.interests:
+            catch_all.append(r)
+            continue
+        for name in r.interests:
+            by_type.setdefault(getattr(ast, name), []).append(r)
+    return by_type, catch_all
+
+
+def lint_source(source: str, rel_path: str, rules: list[Rule],
+                path: str | None = None) -> list[Finding]:
+    try:
+        tree = ast.parse(source, filename=rel_path)
+    except SyntaxError as e:
+        return [Finding(path=rel_path, line=e.lineno or 1, col=0,
+                        rule="parse-error", code="GL000",
+                        message=f"syntax error: {e.msg}")]
+    ctx = ModuleContext(path or rel_path, rel_path, source, tree)
+    for r in rules:
+        r.begin_module(ctx)
+    _walk(tree, ctx, *_dispatch_table(rules))
+    for r in rules:
+        r.end_module(ctx)
+    return assign_occurrences(ctx.findings)
+
+
+def lint_file(path: str, root: str, rules: list[Rule]) -> list[Finding]:
+    root = root.rstrip(os.sep)
+    rel = path[len(root) + 1:] if path.startswith(root + os.sep) else path
+    with open(path, encoding="utf-8", errors="replace") as f:
+        source = f.read()
+    return lint_source(source, rel, rules, path=path)
+
+
+def iter_python_files(paths: list[str]) -> list[str]:
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            out.extend(os.path.join(dirpath, n) for n in sorted(filenames)
+                       if n.endswith(".py"))
+    return out
+
+
+def lint_paths(paths: list[str], rules: list[Rule],
+               root: str | None = None) -> list[Finding]:
+    root = os.path.abspath(root or os.getcwd())
+    findings: list[Finding] = []
+    for path in iter_python_files(paths):
+        findings.extend(lint_file(os.path.abspath(path), root, rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings
+
+
+def _walk(node: ast.AST, ctx: ModuleContext, by_type: dict,
+          catch_all: list[Rule]) -> None:
+    for r in by_type.get(type(node), ()):
+        r.visit(node, ctx)
+    for r in catch_all:
+        r.visit(node, ctx)
+
+    if isinstance(node, (ast.Import, ast.ImportFrom)):
+        ctx.track_import(node)
+        return
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        for dec in node.decorator_list:
+            _walk(dec, ctx, by_type, catch_all)
+        ctx.func_stack.append(node)
+        try:
+            for child in node.body:
+                _walk(child, ctx, by_type, catch_all)
+        finally:
+            ctx.func_stack.pop()
+        return
+    if isinstance(node, ast.ClassDef):
+        for dec in node.decorator_list:
+            _walk(dec, ctx, by_type, catch_all)
+        ctx.class_stack.append(node)
+        try:
+            for child in node.body:
+                _walk(child, ctx, by_type, catch_all)
+        finally:
+            ctx.class_stack.pop()
+        return
+    if isinstance(node, (ast.With, ast.AsyncWith)):
+        held = []
+        for item in node.items:
+            _walk(item.context_expr, ctx, by_type, catch_all)
+            if item.optional_vars is not None:
+                _walk(item.optional_vars, ctx, by_type, catch_all)
+            qn = qualname(item.context_expr)
+            if qn is not None:
+                held.append(qn)
+        ctx.lock_stack.extend(held)
+        try:
+            for child in node.body:
+                _walk(child, ctx, by_type, catch_all)
+        finally:
+            if held:
+                del ctx.lock_stack[-len(held):]
+        return
+    for child in ast.iter_child_nodes(node):
+        _walk(child, ctx, by_type, catch_all)

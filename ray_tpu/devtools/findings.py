@@ -1,0 +1,61 @@
+"""The Finding record and its stable fingerprint.
+
+Fingerprints key the baseline file. They deliberately exclude the line
+NUMBER — a finding must survive unrelated edits above it — and instead
+hash the file path, rule name, the stripped source line text, and an
+occurrence index to disambiguate identical lines in one file.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Finding:
+    path: str          # repo-relative, forward slashes
+    line: int          # 1-based
+    col: int           # 0-based
+    rule: str          # rule name, e.g. "guarded-by"
+    code: str          # rule code, e.g. "GL005"
+    message: str
+    line_text: str = ""
+    occurrence: int = field(default=0)  # nth identical (path,rule,text)
+
+    def fingerprint(self) -> str:
+        h = hashlib.sha1()
+        h.update(self.path.encode())
+        h.update(b"\x00")
+        h.update(self.rule.encode())
+        h.update(b"\x00")
+        h.update(self.line_text.strip().encode())
+        h.update(b"\x00")
+        h.update(str(self.occurrence).encode())
+        return h.hexdigest()
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "rule": self.rule,
+            "code": self.code,
+            "message": self.message,
+            "fingerprint": self.fingerprint(),
+        }
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col + 1}: "
+                f"{self.code} [{self.rule}] {self.message}")
+
+
+def assign_occurrences(findings: list[Finding]) -> list[Finding]:
+    """Number findings that share (path, rule, line text) so their
+    fingerprints stay distinct and stable under reordering."""
+    seen: dict[tuple, int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
+        key = (f.path, f.rule, f.line_text.strip())
+        f.occurrence = seen.get(key, 0)
+        seen[key] = f.occurrence + 1
+    return findings

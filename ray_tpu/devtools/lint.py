@@ -1,0 +1,148 @@
+"""graftlint CLI.
+
+Usage::
+
+    python -m ray_tpu.devtools.lint ray_tpu/            # human output
+    python -m ray_tpu.devtools.lint ray_tpu/ --json     # machine output
+    python -m ray_tpu.devtools.lint --list-rules        # rule catalog
+    python -m ray_tpu.devtools.lint ray_tpu/ --write-baseline
+
+Exit codes: 0 clean (or everything baselined), 1 new findings,
+2 usage/configuration error.
+
+The baseline file (default ``graftlint.baseline.json`` next to the
+package, i.e. the repo root) records fingerprints of known findings so
+new code is held to a clean bar while legacy findings burn down
+incrementally. This repo's committed baseline is empty — keep it that
+way by fixing, not baselining.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from ray_tpu.devtools import baseline as baseline_mod
+from ray_tpu.devtools.driver import lint_paths
+from ray_tpu.devtools.registry import all_rules, rule_catalog
+
+
+def repo_root() -> str:
+    """The directory containing the ray_tpu package (the repo root in
+    a source checkout)."""
+    import ray_tpu
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(
+        ray_tpu.__file__)))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(repo_root(), baseline_mod.DEFAULT_BASELINE)
+
+
+def run(paths: list[str], *, baseline_path: str | None = None,
+        select: set[str] | None = None, root: str | None = None):
+    """Programmatic entry point: returns (new, baselined) findings."""
+    findings = lint_paths(paths, all_rules(select), root=root or repo_root())
+    known = baseline_mod.load(baseline_path) if baseline_path else {}
+    return baseline_mod.split(findings, known)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST-based concurrency & SPMD-correctness lint "
+                    "for the ray_tpu runtime")
+    ap.add_argument("paths", nargs="*", help="files or directories "
+                    "(default: the ray_tpu package)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="baseline file (default: graftlint.baseline."
+                         "json at the repo root)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline file")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="freeze current findings into the baseline")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="drop baseline entries that no longer fire")
+    ap.add_argument("--select", default=None, metavar="RULES",
+                    help="comma-separated rule names/codes to run")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for cls in rule_catalog():
+            print(f"{cls.code}  {cls.name}")
+            print(f"       {cls.description}")
+            print(f"       protects: {cls.invariant}")
+        return 0
+
+    paths = args.paths or [os.path.join(repo_root(), "ray_tpu")]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"graftlint: no such path: {p}", file=sys.stderr)
+            return 2
+    select = ({s.strip() for s in args.select.split(",")}
+              if args.select else None)
+    try:
+        all_rules(select)  # fail fast on a typoed selector
+    except ValueError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+    baseline_path = None if args.no_baseline else (
+        args.baseline or default_baseline_path())
+
+    t0 = time.monotonic()
+    findings = lint_paths(paths, all_rules(select), root=repo_root())
+    elapsed = time.monotonic() - t0
+
+    if args.write_baseline or args.prune_baseline:
+        # a narrowed run (explicit paths / --select) sees only a subset
+        # of findings; freezing or pruning from it would silently drop
+        # every baseline entry outside the subset
+        if args.paths or select:
+            print("graftlint: --write-baseline/--prune-baseline need a "
+                  "full run; drop the explicit paths and --select",
+                  file=sys.stderr)
+            return 2
+        path = baseline_path or default_baseline_path()
+        if args.write_baseline:
+            baseline_mod.save(path, findings)
+            print(f"graftlint: wrote {len(findings)} finding(s) to {path}")
+        else:
+            removed = baseline_mod.prune(path, findings)
+            print(f"graftlint: pruned {removed} stale baseline entr"
+                  f"{'y' if removed == 1 else 'ies'} from {path}")
+        return 0
+
+    try:
+        known = baseline_mod.load(baseline_path) if baseline_path else {}
+    except ValueError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+    new, baselined = baseline_mod.split(findings, known)
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [f.to_dict() for f in new],
+            "baselined": [f.to_dict() for f in baselined],
+            "elapsed_s": round(elapsed, 3),
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        summary = (f"graftlint: {len(new)} finding(s)"
+                   + (f", {len(baselined)} baselined" if baselined else "")
+                   + f" ({elapsed:.2f}s)")
+        print(summary if new or baselined else
+              f"graftlint: clean ({elapsed:.2f}s)")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

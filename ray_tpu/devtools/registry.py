@@ -1,0 +1,87 @@
+"""Rule plugin registry.
+
+A rule is a class with a ``name`` (used in suppression comments and
+baselines), a ``code`` (stable short id, GLnnn), and three hooks the
+single-pass driver calls per module: ``begin_module``, ``visit`` (once
+per AST node, pre-order), and ``end_module``. Rules that need whole-
+module knowledge (call graphs, annotation tables) collect during
+``visit`` and emit findings in ``end_module`` — the driver still walks
+the tree exactly once.
+
+Registering is one decorator::
+
+    @register
+    class MyRule(Rule):
+        name = "my-rule"
+        code = "GL099"
+        description = "..."
+        invariant = "..."
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    import ast
+
+    from ray_tpu.devtools.context import ModuleContext
+
+_RULES: dict[str, type["Rule"]] = {}
+
+
+class Rule:
+    name: str = ""
+    code: str = ""
+    description: str = ""
+    invariant: str = ""  # the runtime property the rule protects
+    # AST node class names this rule's visit() wants; () means every
+    # node. The driver builds a per-type dispatch table from these so a
+    # rule only pays for nodes it can act on.
+    interests: tuple[str, ...] = ()
+
+    def begin_module(self, ctx: "ModuleContext") -> None:
+        pass
+
+    def visit(self, node: "ast.AST", ctx: "ModuleContext") -> None:
+        pass
+
+    def end_module(self, ctx: "ModuleContext") -> None:
+        pass
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    if not cls.name or not cls.code:
+        raise ValueError(f"rule {cls.__name__} needs name and code")
+    if cls.name in _RULES and _RULES[cls.name] is not cls:
+        raise ValueError(f"duplicate rule name {cls.name!r}")
+    _RULES[cls.name] = cls
+    return cls
+
+
+def all_rules(select: set[str] | None = None) -> list[Rule]:
+    """Instantiate registered rules (loading the bundled rule package
+    on first use). ``select`` filters by name or code; unknown entries
+    raise — a typo silently selecting zero rules would turn the lint
+    gate into a no-op that reports clean."""
+    from ray_tpu.devtools import rules as _bundled  # noqa: F401
+
+    if select:
+        known = {c.name for c in _RULES.values()} | {
+            c.code for c in _RULES.values()}
+        unknown = set(select) - known
+        if unknown:
+            raise ValueError(
+                f"unknown rule selector(s): {', '.join(sorted(unknown))}")
+    out = []
+    for cls in sorted(_RULES.values(), key=lambda c: c.code):
+        if select and cls.name not in select and cls.code not in select:
+            continue
+        out.append(cls())
+    return out
+
+
+def rule_catalog() -> list[type[Rule]]:
+    from ray_tpu.devtools import rules as _bundled  # noqa: F401
+
+    return sorted(_RULES.values(), key=lambda c: c.code)

@@ -1,0 +1,14 @@
+"""Bundled graftlint rules. Importing this package registers them all.
+
+Adding a rule: create a module here, subclass ``Rule``, decorate with
+``@register``, and import it below. See DEVTOOLS.md for the catalog.
+"""
+
+from ray_tpu.devtools.rules import (  # noqa: F401
+    async_blocking,
+    discarded_future,
+    except_hygiene,
+    guarded_by,
+    host_transfer,
+    spmd_nondeterminism,
+)

@@ -1,0 +1,128 @@
+"""Shared infrastructure for rules about jax-traced (SPMD) code.
+
+Builds, during the driver's single pass, a per-module view of:
+- which functions are trace roots — decorated with ``jax.jit`` /
+  ``pmap`` / ``shard_map`` (including ``partial(jax.jit, ...)`` forms)
+  or wrapped by a ``jax.jit(fn)`` / ``shard_map(fn, ...)`` call
+  anywhere in the module (the dominant idiom in this repo:
+  ``self._update = jax.jit(update)``);
+- the module-local call graph (flat, by function name — precise enough
+  for the single-file helper functions traced code is built from);
+- per-function violation sites collected by the concrete rule.
+
+``end_module`` then walks reachability from the trace roots and reports
+only violations inside traced code, naming the root they are reachable
+from.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+
+from ray_tpu.devtools.context import ModuleContext, qualname
+from ray_tpu.devtools.registry import Rule
+
+_TRACE_TAILS = ("jit", "pmap", "shard_map")
+
+
+def _is_trace_ref(node: ast.AST, ctx: ModuleContext) -> bool:
+    """Does this expression refer to jax.jit / pmap / shard_map?"""
+    qn = qualname(node)
+    if qn is None:
+        return False
+    resolved = ctx.resolve(qn)
+    return resolved.rsplit(".", 1)[-1] in _TRACE_TAILS and (
+        resolved.startswith(("jax", "shard_map"))
+        or resolved in _TRACE_TAILS)
+
+
+def _trace_decorated(fn: ast.FunctionDef | ast.AsyncFunctionDef,
+                     ctx: ModuleContext) -> bool:
+    for dec in fn.decorator_list:
+        if _is_trace_ref(dec, ctx):
+            return True
+        # @partial(jax.jit, static_argnums=...) and friends
+        if isinstance(dec, ast.Call):
+            if _is_trace_ref(dec.func, ctx):
+                return True
+            if any(_is_trace_ref(a, ctx) for a in dec.args):
+                return True
+    return False
+
+
+class TracedCodeRule(Rule):
+    """Base for rules that flag constructs reachable from traced code.
+
+    Subclasses implement ``check_call(node, ctx) -> str | None`` (a
+    violation message, or None) and may extend ``check_node`` for
+    non-Call sites.
+    """
+
+    interests = ("FunctionDef", "AsyncFunctionDef", "Call")
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        self._roots: set[str] = set()
+        self._calls: dict[str, set[str]] = {}
+        self._violations: dict[str, list[tuple[ast.AST, str]]] = {}
+        self._local_funcs: set[str] = set()
+        # no trace machinery in the module -> nothing can be a root
+        self._enabled = ("jit" in ctx.source or "pmap" in ctx.source
+                         or "shard_map" in ctx.source)
+
+    # ------------------------------------------------------------ pass 1
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if not self._enabled:
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._local_funcs.add(node.name)
+            if _trace_decorated(node, ctx):
+                self._roots.add(node.name)
+            return
+        if not isinstance(node, ast.Call):
+            return
+        fn = ctx.current_function
+        scope = fn.name if fn is not None else "<module>"
+        # jax.jit(update) / shard_map(step, mesh=...): every Name
+        # argument is a traced entry point
+        if _is_trace_ref(node.func, ctx):
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    self._roots.add(arg.id)
+        callee = qualname(node.func)
+        if callee is not None and "." not in callee:
+            self._calls.setdefault(scope, set()).add(callee)
+        elif callee is not None and callee.startswith("self."):
+            # method calls within one class: flat name is close enough
+            self._calls.setdefault(scope, set()).add(
+                callee.split(".", 1)[1])
+        msg = self.check_call(node, ctx)
+        if msg is not None:
+            self._violations.setdefault(scope, []).append((node, msg))
+
+    # ------------------------------------------------------------ pass 2
+
+    def end_module(self, ctx: ModuleContext) -> None:
+        if not self._roots:
+            return
+        reachable: set[str] = set()
+        todo = deque(self._roots)
+        while todo:
+            name = todo.popleft()
+            if name in reachable:
+                continue
+            reachable.add(name)
+            todo.extend(self._calls.get(name, set()) & self._local_funcs)
+        for scope, sites in self._violations.items():
+            if scope not in reachable:
+                continue
+            for node, msg in sites:
+                ctx.report(self, node,
+                           f"{msg} (in {scope!r}, reachable from a "
+                           f"jit/pmap/shard_map trace root)")
+
+    # ------------------------------------------------------------ hooks
+
+    def check_call(self, node: ast.Call, ctx: ModuleContext) -> str | None:
+        raise NotImplementedError

@@ -1,0 +1,84 @@
+"""GL001: blocking calls inside async actor methods and RPC handlers.
+
+The runtime's classic deadlock: an ``async def`` actor method calls the
+blocking ``ray_tpu.get()`` / ``wait()`` on a future produced by its own
+event loop — the loop thread parks forever. The same applies to the
+control plane's RPC handler callbacks (``_h_*`` methods on the
+nodelet/head/runtime/worker): they run on a bounded server thread pool,
+so an indefinite block (``time.sleep``, a timeout-less ``Event.wait()``
+or ``Queue.get()``) can starve every other handler, including the one
+that would have unblocked it.
+
+Allowed: awaiting, executor offload (``run_in_executor``), and bounded
+waits — the indefinite-block methods pass once they carry any argument
+(a timeout). Blocking ray get()/wait() and ``time.sleep`` are flagged
+regardless of timeouts: even bounded, they park a pool/loop thread for
+the duration — route them to the RPC slow lane or an executor, or
+suppress with a justification (see ``ray_tpu/client.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools.context import ModuleContext
+from ray_tpu.devtools.registry import Rule, register
+
+_RAY_BLOCKING = {
+    "ray_tpu.get", "ray_tpu.wait",
+    "ray_tpu.core.api.get", "ray_tpu.core.api.wait",
+}
+# zero-arg methods that block indefinitely on the usual suspects
+_INDEFINITE_METHODS = {"wait", "get", "acquire", "join", "result"}
+
+
+@register
+class AsyncBlockingRule(Rule):
+    name = "async-blocking"
+    code = "GL001"
+    description = ("blocking get()/wait()/sleep inside async actor "
+                   "methods or _h_* RPC handler callbacks")
+    invariant = ("event-loop and handler-pool threads never block on "
+                 "results that need those same threads to progress")
+    interests = ("Await", "Call")
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        self._awaited: set[int] = set()
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if isinstance(node, ast.Await):
+            # `await x.wait()` is the NON-blocking asyncio form
+            if isinstance(node.value, ast.Call):
+                self._awaited.add(id(node.value))
+            return
+        if not isinstance(node, ast.Call) or id(node) in self._awaited:
+            return
+        fn = ctx.current_function
+        if fn is None:
+            return
+        in_async = isinstance(fn, ast.AsyncFunctionDef)
+        in_handler = (fn.name.startswith("_h_")
+                      and ctx.current_class is not None)
+        if not (in_async or in_handler):
+            return
+        where = ("async function" if in_async else
+                 f"RPC handler {ctx.current_class.name}.{fn.name}")
+
+        resolved = ctx.resolve_call(node)
+        if resolved in _RAY_BLOCKING:
+            ctx.report(self, node,
+                       f"blocking {resolved}() inside {where}: deadlocks "
+                       f"when the result needs this thread; restructure "
+                       f"or offload to an executor")
+            return
+        if resolved == "time.sleep":
+            ctx.report(self, node,
+                       f"time.sleep() inside {where} parks a shared "
+                       f"thread; use asyncio.sleep or an Event timeout")
+            return
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _INDEFINITE_METHODS
+                and not node.args and not node.keywords):
+            ctx.report(self, node,
+                       f".{node.func.attr}() with no timeout inside "
+                       f"{where} can block forever; pass a timeout")

@@ -1,0 +1,40 @@
+"""GL002: discarded ``.remote()`` futures.
+
+A ``.remote()`` call whose ObjectRef is thrown away as a bare
+expression statement leaks the submitted work: its errors can never be
+observed (``get`` is what re-raises them), retries/backpressure never
+apply, and the owner-side bookkeeping keeps the ref alive until
+process exit. Fire-and-forget is occasionally intentional — say so
+with ``# graftlint: disable=discarded-future`` at the call site, or
+bind the ref.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools.context import ModuleContext
+from ray_tpu.devtools.registry import Rule, register
+
+
+def _is_remote_call(value: ast.expr) -> bool:
+    return (isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Attribute)
+            and value.func.attr == "remote")
+
+
+@register
+class DiscardedFutureRule(Rule):
+    name = "discarded-future"
+    code = "GL002"
+    description = ".remote() result discarded as a bare statement"
+    invariant = ("every submitted task/actor-call has an owner that can "
+                 "observe its error")
+    interests = ("Expr",)
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if isinstance(node, ast.Expr) and _is_remote_call(node.value):
+            ctx.report(self, node,
+                       "ObjectRef from .remote() is discarded: errors "
+                       "become unobservable and the ref leaks; bind it "
+                       "(or suppress if fire-and-forget is intended)")

@@ -1,0 +1,81 @@
+"""GL006: bare ``except:`` and swallowed cancellation.
+
+Runtime code that catches everything — bare ``except:``, or
+``BaseException`` / ``KeyboardInterrupt`` / ``CancelledError`` — and
+neither re-raises nor records the exception turns worker cancellation
+and operator Ctrl-C into silent no-ops: the task "succeeds", the soak
+test hangs, the node never drains. The handler passes when it contains
+a ``raise`` or actually uses the bound exception name (storing it for
+a supervisor to re-raise is this repo's sanctioned pattern, e.g. the
+train/tune thread runners).
+
+One carve-out: ``except KeyboardInterrupt`` in a ``main()`` function
+or at module level is the standard clean-^C CLI exit and is allowed.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools.context import ModuleContext, qualname
+from ray_tpu.devtools.registry import Rule, register
+
+_FATAL = {"BaseException", "KeyboardInterrupt", "CancelledError",
+          "GeneratorExit"}
+
+
+def _caught_names(type_node: ast.expr | None) -> set[str]:
+    if type_node is None:
+        return set()
+    nodes = type_node.elts if isinstance(type_node, ast.Tuple) \
+        else [type_node]
+    out = set()
+    for n in nodes:
+        qn = qualname(n)
+        if qn:
+            out.add(qn.rsplit(".", 1)[-1])
+    return out
+
+
+def _handler_reraises_or_uses(handler: ast.ExceptHandler) -> bool:
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Raise):
+                return True
+            if (handler.name and isinstance(node, ast.Name)
+                    and node.id == handler.name
+                    and isinstance(node.ctx, ast.Load)):
+                return True
+    return False
+
+
+@register
+class ExceptHygieneRule(Rule):
+    name = "except-hygiene"
+    code = "GL006"
+    description = ("bare except / swallowed BaseException, "
+                   "KeyboardInterrupt or CancelledError")
+    invariant = ("cancellation and operator interrupts always "
+                 "propagate or get recorded, never vanish")
+    interests = ("ExceptHandler",)
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if not isinstance(node, ast.ExceptHandler):
+            return
+        if node.type is None:
+            ctx.report(self, node,
+                       "bare `except:` also swallows KeyboardInterrupt/"
+                       "SystemExit and cancellation; catch Exception "
+                       "(or narrower)")
+            return
+        fatal = _caught_names(node.type) & _FATAL
+        if not fatal or _handler_reraises_or_uses(node):
+            return
+        fn = ctx.current_function
+        at_cli_top = fn is None or fn.name == "main"
+        if fatal == {"KeyboardInterrupt"} and at_cli_top:
+            return  # standard clean-^C exit in a CLI entry point
+        ctx.report(self, node,
+                   f"except {'/'.join(sorted(fatal))} neither re-raises "
+                   f"nor uses the exception: cancellation/interrupts "
+                   f"are silently swallowed")

@@ -1,0 +1,145 @@
+"""GL005: mutation of ``# guarded_by(<lock>)`` state outside its lock.
+
+The nodelet, cluster runtime, and object store share mutable maps and
+counters across their RPC-handler pool and background threads. An
+attribute whose initializing assignment carries a
+``# guarded_by(<lock>)`` comment may only be MUTATED (assigned,
+aug-assigned, deleted, or called with a mutating method like
+``append``/``pop``/``update``) while an enclosing ``with self.<lock>:``
+holds the named lock. Reads are not checked — callers that read a
+stale snapshot are a (documented) design choice here; unlocked writes
+are races.
+
+Two caller-holds-the-lock conventions are honored, matching existing
+code: a ``*_locked`` function-name suffix, and a docstring containing
+"caller holds self._lock".
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ray_tpu.devtools.context import ModuleContext
+from ray_tpu.devtools.registry import Rule, register
+
+# anywhere in a trailing comment, so it composes with existing notes:
+#   self._queue = deque()  # task queue; guarded_by(_lock)
+_ANNOT_RE = re.compile(r"#.*?guarded_by\(\s*(?:self\.)?([\w\.]+)\s*\)")
+
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "remove",
+    "pop", "popleft", "popitem", "clear", "add", "discard", "update",
+    "setdefault", "rotate", "sort", "reverse",
+}
+_INIT_FUNCS = {"__init__", "__new__", "__init_subclass__"}
+
+
+def _self_attr_of(node: ast.expr) -> str | None:
+    """The 'X' in a self.X / self.X[k] / self.X[k].y chain, or None."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        node = node.value
+    return None
+
+
+@register
+class GuardedByRule(Rule):
+    name = "guarded-by"
+    code = "GL005"
+    description = ("guarded_by(<lock>)-annotated attribute mutated "
+                   "outside a matching `with <lock>:` block")
+    invariant = ("annotated shared state only mutates while its lock "
+                 "is held")
+    interests = ("Assign", "AnnAssign", "AugAssign", "Delete", "Call")
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        # (class name, attr) -> lock qualname ("self._lock")
+        self._annotations: dict[tuple[str, str], str] = {}
+        # deferred mutation events, judged in end_module once the whole
+        # annotation table exists
+        self._events: list[tuple] = []
+        self._enabled = "guarded_by(" in ctx.source
+
+    # ---------------------------------------------------------------- visit
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if not self._enabled:
+            return
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._maybe_annotation(node, ctx)
+            for target in self._targets(node):
+                attr = _self_attr_of(target)
+                if attr is not None:
+                    self._record(attr, node, ctx)
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                attr = _self_attr_of(target)
+                if attr is not None:
+                    self._record(attr, node, ctx)
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr in _MUTATORS):
+            attr = _self_attr_of(node.func.value)
+            if attr is not None:
+                self._record(attr, node, ctx)
+
+    @staticmethod
+    def _targets(node) -> list[ast.expr]:
+        if isinstance(node, ast.Assign):
+            out = []
+            for t in node.targets:
+                out.extend(t.elts if isinstance(t, ast.Tuple) else [t])
+            return out
+        return [node.target]
+
+    def _maybe_annotation(self, node, ctx: ModuleContext) -> None:
+        line = ctx.lines[node.lineno - 1] if node.lineno <= len(ctx.lines) \
+            else ""
+        m = _ANNOT_RE.search(line)
+        if not m and node.lineno >= 2:
+            # annotation on a standalone comment line directly above
+            prev = ctx.lines[node.lineno - 2]
+            if prev.strip().startswith("#"):
+                m = _ANNOT_RE.search(prev)
+        if not m or ctx.current_class is None:
+            return
+        lock = m.group(1)
+        if not lock.startswith("self."):
+            lock = f"self.{lock}"
+        for target in self._targets(node):
+            attr = _self_attr_of(target)
+            if attr is not None:
+                self._annotations[(ctx.current_class.name, attr)] = lock
+
+    def _record(self, attr: str, node: ast.AST, ctx: ModuleContext) -> None:
+        if ctx.current_class is None or ctx.current_function is None:
+            return
+        fn = ctx.current_function
+        if fn.name in _INIT_FUNCS:
+            return  # construction happens-before sharing
+        docs = [(f.name, (ast.get_docstring(f, clean=False) or "").lower())
+                for f in ctx.func_stack]
+        self._events.append((ctx.current_class.name, attr, node,
+                             tuple(ctx.lock_stack), docs))
+
+    # ------------------------------------------------------------ end pass
+
+    def end_module(self, ctx: ModuleContext) -> None:
+        for cls, attr, node, held, docs in self._events:
+            lock = self._annotations.get((cls, attr))
+            if lock is None:
+                continue
+            if lock in held:
+                continue
+            if any(name.endswith("_locked") or f"holds {lock}" in doc
+                   for name, doc in docs):
+                continue
+            fn_name = docs[-1][0] if docs else "?"
+            ctx.report(self, node,
+                       f"self.{attr} is guarded_by({lock}) but "
+                       f"{cls}.{fn_name} mutates it without holding "
+                       f"the lock")

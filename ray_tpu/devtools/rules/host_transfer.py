@@ -1,0 +1,43 @@
+"""GL004: implicit host transfers inside traced/training-step code.
+
+``.item()``, ``.tolist()``, ``np.asarray(...)`` and
+``jax.device_get(...)`` on a traced value force a device→host copy and
+a blocking synchronization — inside a jit/pmap/shard_map trace they
+either fail at trace time (TracerArrayConversionError) or, worse,
+silently fence the accelerator pipeline on every step when applied to
+the function's inputs. Training-step code should keep values on device
+and transfer explicitly at the logging boundary.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools.context import ModuleContext
+from ray_tpu.devtools.registry import register
+from ray_tpu.devtools.rules._traced import TracedCodeRule
+
+_TRANSFER_METHODS = {"item", "tolist"}
+_TRANSFER_CALLS = {"numpy.asarray", "numpy.array", "jax.device_get"}
+
+
+@register
+class HostTransferRule(TracedCodeRule):
+    name = "host-transfer"
+    code = "GL004"
+    description = (".item()/np.asarray/jax.device_get inside "
+                   "traced/training-step code")
+    invariant = ("traced code never forces a device->host copy; "
+                 "transfers happen explicitly at the host boundary")
+
+    def check_call(self, node: ast.Call, ctx: ModuleContext) -> str | None:
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _TRANSFER_METHODS
+                and not node.args and not node.keywords):
+            return (f".{node.func.attr}() forces a device->host "
+                    f"transfer and pipeline sync")
+        resolved = ctx.resolve_call(node)
+        if resolved in _TRANSFER_CALLS:
+            return (f"{resolved}() materializes the value on host "
+                    f"inside traced code")
+        return None

@@ -1,0 +1,52 @@
+"""GL003: nondeterminism reachable from jax-traced code.
+
+SPMD correctness (veScale-style replica determinism) requires every
+replica to trace the SAME computation. Wall-clock reads and host-side
+RNG (``time.time``, stdlib ``random``, ``np.random``) inside a
+``jax.jit`` / ``pmap`` / ``shard_map`` root — or any module-local
+helper it calls — bake a per-process value into the trace: replicas
+diverge, caches miss, and cross-replica collectives deadlock on
+mismatched programs. Key-passing ``jax.random`` is the deterministic
+alternative and is never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools.context import ModuleContext
+from ray_tpu.devtools.registry import register
+from ray_tpu.devtools.rules._traced import TracedCodeRule
+
+_CLOCK_CALLS = {
+    "time.time", "time.time_ns", "time.monotonic", "time.perf_counter",
+    "time.perf_counter_ns", "datetime.datetime.now",
+}
+_RNG_PREFIXES = ("random.", "numpy.random.", "secrets.", "uuid.")
+
+
+@register
+class SpmdNondeterminismRule(TracedCodeRule):
+    name = "spmd-nondeterminism"
+    code = "GL003"
+    description = ("wall clock / host RNG reachable from "
+                   "jit/pmap/shard_map-traced code")
+    invariant = ("traced programs are replica-deterministic: every "
+                 "replica traces the same computation")
+
+    def check_call(self, node: ast.Call, ctx: ModuleContext) -> str | None:
+        resolved = ctx.resolve_call(node)
+        if resolved is None or resolved.startswith("jax."):
+            return None  # jax.random is the deterministic path
+        if resolved in _CLOCK_CALLS:
+            return (f"wall-clock read {resolved}() bakes a per-process "
+                    f"value into the trace")
+        head = resolved.split(".", 1)[0]
+        if resolved.startswith(_RNG_PREFIXES) and (
+                head in ("numpy", "random", "secrets", "uuid")):
+            return (f"host RNG {resolved}() diverges across replicas; "
+                    f"thread a jax.random key instead")
+        if resolved == "os.urandom":
+            return ("os.urandom() diverges across replicas; thread a "
+                    "jax.random key instead")
+        return None

@@ -173,6 +173,9 @@ class JobSubmissionClient:
         job_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
         sup_cls = ray_tpu.remote(num_cpus=0.1,
                                  runtime_env=runtime_env)(_JobSupervisor)
+        # detached supervisor: the handle is deliberately dropped — its
+        # lifetime is head-managed and it is recovered by name below
+        # graftlint: disable=discarded-future
         sup_cls.options(name=f"__job_{job_id}",
                         lifetime="detached").remote(
             job_id, entrypoint, self.address)

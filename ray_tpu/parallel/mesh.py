@@ -19,6 +19,17 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
+# Replica-deterministic RNG under sharding (the invariant graftlint
+# GL003 protects): with the legacy non-partitionable threefry (the
+# default before jax 0.5), a jitted `jax.random.*` whose output is
+# sharded computes DIFFERENT bits than the same call unsharded — the
+# partitioner rewrites the counter layout — so sharded init/dropout
+# silently diverges from the single-device program. Partitionable
+# threefry makes the bits a pure function of key+shape regardless of
+# sharding. Newer jax defaults to True; force it on older versions.
+if not getattr(jax.config, "jax_threefry_partitionable", True):
+    jax.config.update("jax_threefry_partitionable", True)
+
 # Canonical axis names. Order matters: the slowest-varying axis should be
 # the one crossing DCN (dcn/data), the fastest-varying ones (tensor/seq)
 # need the highest bandwidth and should map to adjacent ICI neighbors.

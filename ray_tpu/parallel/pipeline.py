@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ray_tpu.parallel.ops import axis_size as _axis_size
+
 
 def pipeline_apply(stage_fn, stage_params, x, axis_name: str = "pipe",
                    num_microbatches: int | None = None) -> jax.Array:
@@ -34,7 +36,7 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name: str = "pipe",
     (replicated along the pipe axis), split into `num_microbatches`
     equal microbatches along dim 0. Returns the full output batch.
     """
-    S = lax.axis_size(axis_name)
+    S = _axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     B = x.shape[0]
     M = num_microbatches or S
@@ -103,7 +105,7 @@ def pipeline_apply_interleaved(stage_fn, stage_params, x,
     v // S). Requires M >= S (the park time M-S+1 must be >= 1... it is
     >= 0; M >= S keeps the buffer causal).
     """
-    S = lax.axis_size(axis_name)
+    S = _axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     R = num_repeats
     B = x.shape[0]

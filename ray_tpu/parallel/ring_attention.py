@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ray_tpu.parallel.ops import axis_size as _axis_size
+
 _NEG = -1e30
 
 
@@ -35,7 +37,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     array sharded on dim 1 over `axis_name`. Returns the matching output
     shard. Call inside shard_map/pjit-manual over that axis."""
     B, t, H, D = q.shape
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     scale = 1.0 / (D ** 0.5)
     qf = q.astype(jnp.float32)

@@ -92,8 +92,8 @@ def cmd_start(args):
         try:
             while True:
                 time.sleep(3600)
-        except KeyboardInterrupt:
-            pass
+        except KeyboardInterrupt:  # graftlint: disable=except-hygiene
+            pass  # ^C IS the stop signal: shutdown continues right below
         nodelet.stop()
         if head is not None:
             head.stop()

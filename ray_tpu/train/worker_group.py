@@ -59,18 +59,27 @@ class TrainWorker:
         dist.init_process_group here, train/torch/config.py:66-124; the
         jax-native equivalent is jax.distributed.initialize with rank-0's
         address)."""
-        if num_cpu_devices:
-            # an inherited --xla_force_host_platform_device_count (e.g.
-            # from a test driver) would override jax_num_cpu_devices
-            flags = os.environ.get("XLA_FLAGS", "")
-            kept = [f for f in flags.split() if
-                    "--xla_force_host_platform_device_count" not in f]
-            os.environ["XLA_FLAGS"] = " ".join(kept)
         import jax
 
         if num_cpu_devices:
+            # strip any inherited --xla_force_host_platform_device_count
+            # (e.g. from a test driver): it would override
+            # jax_num_cpu_devices where that option exists, and fight
+            # the value we append for jax<0.5. The backend initializes
+            # lazily at the jax.devices() call below, so editing
+            # XLA_FLAGS after the import is still in time.
+            flags = os.environ.get("XLA_FLAGS", "")
+            kept = [f for f in flags.split() if
+                    "--xla_force_host_platform_device_count" not in f]
+            if hasattr(jax.config, "jax_num_cpu_devices"):
+                jax.config.update("jax_num_cpu_devices",
+                                  int(num_cpu_devices))
+            else:
+                # jax<0.5: the XLA flag IS the device-count mechanism
+                kept.append("--xla_force_host_platform_device_count="
+                            f"{int(num_cpu_devices)}")
+            os.environ["XLA_FLAGS"] = " ".join(kept)
             jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", int(num_cpu_devices))
         if coordinator and num_processes > 1:
             jax.distributed.initialize(
                 coordinator_address=coordinator,

@@ -103,8 +103,8 @@ class TrialActor:
                 fn(config)
             except _StopTrial:
                 pass
-            except BaseException:  # noqa: BLE001
-                self.error = traceback.format_exc()
+            except BaseException as e:  # noqa: BLE001
+                self.error = "".join(traceback.format_exception(e))
             finally:
                 self.finished.set()
 

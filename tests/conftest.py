@@ -19,6 +19,15 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+
+def jax_supports_multiprocess_cpu() -> bool:
+    """jaxlib <0.5 CPU backend: "Multiprocess computations aren't
+    implemented on the CPU backend" — the gang forms, the first
+    collective aborts. Tests that need a multi-process SPMD world
+    gate on this instead of failing on those builds."""
+    return tuple(int(x) for x in jax.__version__.split(".")[:2]) >= (0, 5)
+
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,21 @@
+"""GL001 firing fixture: blocking calls in async + handler contexts.
+
+Never imported — parsed by graftlint in tests only.
+"""
+import time
+
+import ray_tpu
+
+
+class Worker:
+    async def poll(self, ref):
+        return ray_tpu.get([ref])  # FIRE: blocking get in async method
+
+    async def nap(self):
+        time.sleep(1)  # FIRE: time.sleep parks the event loop
+
+
+class Nodelet:
+    def _h_fetch(self, msg, frames):
+        self.ready.wait()  # FIRE: no-timeout wait in an RPC handler
+        return {}

@@ -1,0 +1,24 @@
+"""GL001 non-firing fixture: the sanctioned async/handler patterns."""
+import asyncio
+
+import ray_tpu
+
+
+class Worker:
+    async def poll(self, ref):
+        loop = asyncio.get_running_loop()
+        # offloaded to an executor: the loop thread never blocks
+        return await loop.run_in_executor(None, ray_tpu.get, [ref])
+
+    async def nap(self, ev):
+        await asyncio.sleep(1)
+        await ev.wait()  # awaited asyncio form, not a thread block
+
+
+class Nodelet:
+    def _h_fetch(self, msg, frames):
+        self.ready.wait(timeout=60)  # bounded: ok
+        return {}
+
+    def helper(self, ref):
+        return ray_tpu.get([ref])  # plain sync code: ok

@@ -1,0 +1,6 @@
+"""GL002 firing fixture: .remote() futures thrown away."""
+
+
+def kick(actor, f):
+    f.remote(1)  # FIRE: bare statement discards the ObjectRef
+    actor.step.options(num_cpus=1).remote()  # FIRE: options chain too

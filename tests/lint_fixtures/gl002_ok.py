@@ -1,0 +1,8 @@
+"""GL002 non-firing fixture: refs are bound, returned, or passed."""
+import ray_tpu
+
+
+def kick(actor, f):
+    ref = f.remote(1)
+    refs = [actor.step.remote() for _ in range(2)]
+    return ray_tpu.get([ref] + refs)

@@ -1,0 +1,27 @@
+"""GL003 firing fixture: host RNG / wall clock in traced code."""
+import random
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    return x * random.random()  # FIRE: host RNG in a trace root
+
+
+def helper(x):
+    return x + time.time()  # FIRE: reachable from the jitted loss
+
+
+@jax.jit
+def loss(x):
+    return helper(x)
+
+
+def update(x):
+    return x * np.random.rand()  # FIRE: np RNG, root via jax.jit(update)
+
+
+train = jax.jit(update)

@@ -1,0 +1,15 @@
+"""GL003 non-firing fixture: keyed jax.random, host code off-trace."""
+import random
+import time
+
+import jax
+
+
+@jax.jit
+def step(key, x):
+    return x + jax.random.normal(key, x.shape)  # deterministic: ok
+
+
+def host_side():
+    # wall clock + RNG are fine outside any trace root
+    return time.time(), random.random()

@@ -1,0 +1,11 @@
+"""GL004 firing fixture: implicit host transfers in a training step."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def train_step(params, batch):
+    loss = (params - batch).sum()
+    log_val = loss.item()  # FIRE: device->host sync per step
+    host = np.asarray(batch)  # FIRE: materializes on host under trace
+    return jax.device_get(loss), log_val, host  # FIRE: device_get

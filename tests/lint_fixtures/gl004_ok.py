@@ -1,0 +1,13 @@
+"""GL004 non-firing fixture: transfers only at the host boundary."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def train_step(params, batch):
+    return (params - batch).sum()  # stays on device
+
+
+def report(metrics):
+    # explicit host boundary, not reachable from the trace root
+    return float(np.asarray(metrics).item())

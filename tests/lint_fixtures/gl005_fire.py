@@ -1,0 +1,18 @@
+"""GL005 firing fixture: guarded state mutated without its lock."""
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}  # guarded_by(_lock)
+        self._hits = 0  # guarded_by(_lock)
+
+    def put(self, k, v):
+        self._entries[k] = v  # FIRE: subscript assign, no lock
+
+    def bump(self):
+        self._hits += 1  # FIRE: augassign, no lock
+
+    def evict(self, k):
+        self._entries.pop(k, None)  # FIRE: mutator call, no lock

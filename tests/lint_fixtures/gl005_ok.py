@@ -1,0 +1,25 @@
+"""GL005 non-firing fixture: every mutation holds the lock (or is in
+a caller-holds-the-lock helper)."""
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}  # guarded_by(_lock)
+        self._hits = 0  # guarded_by(_lock)
+
+    def put(self, k, v):
+        with self._lock:
+            self._entries[k] = v
+            self._hits += 1
+
+    def evict_locked(self, k):
+        self._entries.pop(k, None)  # *_locked suffix: caller holds it
+
+    def drop(self, k):
+        """Caller holds self._lock (documented convention)."""
+        del self._entries[k]
+
+    def size(self):
+        return len(self._entries)  # reads are never flagged

@@ -1,0 +1,22 @@
+"""GL006 firing fixture: bare except and swallowed cancellation."""
+
+
+def drain(q):
+    try:
+        q.flush()
+    except:  # FIRE: bare except
+        pass
+
+
+def run(fn):
+    try:
+        fn()
+    except BaseException:  # FIRE: swallowed, nothing recorded
+        return None
+
+
+def poll(task):
+    try:
+        task.step()
+    except KeyboardInterrupt:  # FIRE: ^C vanishes outside main()
+        pass

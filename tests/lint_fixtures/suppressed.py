@@ -1,0 +1,8 @@
+"""Suppression fixture: real violations silenced two ways."""
+
+
+def kick(f):
+    f.remote(1)  # graftlint: disable=discarded-future
+    # graftlint: disable=GL002
+    f.remote(2)
+    f.remote(3)  # graftlint: disable=all

@@ -5,6 +5,7 @@ Reference model: python/ray/tests/ with the ray_start_cluster fixture
 worker processes are real.
 """
 
+import os
 import time
 
 import numpy as np
@@ -16,13 +17,20 @@ from ray_tpu.cluster_utils import Cluster
 
 @pytest.fixture(scope="module")
 def cluster():
-    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
-    c.add_node(num_cpus=4, resources={"magic": 2.0})
-    c.wait_for_nodes()
-    ray_tpu.init(address=c.address)
-    yield c
-    ray_tpu.shutdown()
-    c.shutdown()
+    # warm worker pools: these tests assert scheduling behavior
+    # (parallel dispatch, spread), not cold python-process spawn
+    # latency, which dominates wall time on slow CI boxes
+    os.environ["RAY_TPU_PRESTART_WORKERS"] = "4"
+    try:
+        c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+        c.add_node(num_cpus=4, resources={"magic": 2.0})
+        c.wait_for_nodes()
+        ray_tpu.init(address=c.address)
+        yield c
+        ray_tpu.shutdown()
+        c.shutdown()
+    finally:
+        os.environ.pop("RAY_TPU_PRESTART_WORKERS", None)
 
 
 def test_remote_task_roundtrip(cluster):

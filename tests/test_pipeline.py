@@ -133,6 +133,10 @@ def test_interleaved_differentiable(pipe_mesh):
     assert any(np.abs(a).sum() > 0 for a in flat)
 
 
+@pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="partial-auto shard_map on jax<0.5 lowers to a PartitionId "
+           "op XLA:CPU cannot SPMD-partition")
 def test_pipelined_transformer_hybrid_mesh():
     """Multi-stage transformer (ring attention over fsdp inside the
     blocks, interleaved pipeline over pipe, tensor/dcn left to GSPMD):

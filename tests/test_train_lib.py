@@ -13,6 +13,8 @@ import numpy as np
 import pytest
 
 import ray_tpu
+import conftest
+
 
 # train-loop functions below are module-level in a non-importable test
 # module; ship them by value (reference equivalent: runtime_env
@@ -209,6 +211,9 @@ def _gpt2_loop(config):
             train.report({"loss": loss, "step": step}, checkpoint=ckpt)
 
 
+@pytest.mark.skipif(not conftest.jax_supports_multiprocess_cpu(),
+                    reason="multiprocess SPMD unimplemented on "
+                           "this jaxlib's CPU backend")
 def test_gpt2_loss_parity_1_vs_2_workers(cluster, tmp_path):
     """Same global batch + init => identical loss whether the mesh spans
     one process or two (the SPMD-equivalence guarantee DDP tests assert
