@@ -1,0 +1,202 @@
+"""Serving benchmark — synthetic open-loop load on the LLM engine.
+
+Open-loop (arrivals don't wait for completions, Poisson
+inter-arrivals) is the honest serving shape: closed-loop benchmarks
+self-throttle and hide queueing collapse. Emits one BENCH-style JSON
+line (headline: generated tokens/s; secondary: p50/p99 TTFT) and
+writes SERVE_BENCH.json, so future PRs have a serving perf
+trajectory next to bench.py's training numbers.
+
+    python bench_serve.py [--n 64] [--rate 8] [--model gpt2]
+                          [--preset tiny] [--max-tokens 16] [--serve]
+
+Default drives a bare in-process engine (scheduler+runner+cache, no
+RPC). `--serve` runs the same load through a real serve deployment and
+DeploymentHandle streaming instead — engine + serve overhead together.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+import numpy as np
+
+
+def _requests(n, seed, max_len=32):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, 500, size=int(rng.randint(8, max_len))).tolist()
+            for _ in range(n)]
+
+
+def bench_engine(args) -> dict:
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine, SamplingParams
+
+    eng = LLMEngine(EngineConfig(
+        model=args.model, preset=args.preset, block_size=16,
+        max_model_len=args.max_model_len, max_batch_size=args.batch,
+        num_blocks=args.num_blocks))
+    prompts = _requests(args.n, seed=0, max_len=args.max_model_len // 2)
+    sp = SamplingParams(max_tokens=args.max_tokens)
+
+    # compile every bucketed program outside the measured window
+    eng.warmup()
+
+    stop = threading.Event()
+
+    def step_loop():
+        while not stop.is_set():
+            if not eng.step():
+                time.sleep(0.0005)
+
+    stepper = threading.Thread(target=step_loop, daemon=True)
+    stepper.start()
+
+    # one reader thread per stream: TTFT is measured at first-token
+    # ARRIVAL, concurrent with the open-loop arrivals — a sequential
+    # post-hoc drain would just re-measure the enqueue schedule
+    rng = np.random.RandomState(1)
+    n = args.n
+    ttft = [float("nan")] * n
+    finals = [None] * n
+
+    def consume(i, stream, te):
+        try:
+            first = stream.next_event(timeout=300)
+            if first is not None:
+                ttft[i] = (time.monotonic() - te) * 1e3
+            for _ in stream:
+                pass
+            finals[i] = stream.final()
+        except Exception:  # noqa: BLE001  (stalled engine: leave None)
+            pass
+
+    readers = []
+    t0 = time.monotonic()
+    for i, p in enumerate(prompts):
+        te = time.monotonic()
+        s = eng.add_request(p, sp)
+        th = threading.Thread(target=consume, args=(i, s, te), daemon=True)
+        th.start()
+        readers.append(th)
+        time.sleep(float(rng.exponential(1.0 / args.rate)))
+    for th in readers:
+        th.join(timeout=300)
+    wall = time.monotonic() - t0
+    stop.set()
+    stepper.join(timeout=5)
+
+    n_tokens = sum(f["num_generated"] for f in finals if f)
+    dropped = sum(1 for f in finals
+                  if f is None or f["finish_reason"].startswith("error"))
+    st = eng.stats()
+    return {
+        "tokens_per_sec": n_tokens / wall,
+        "ttft_p50_ms": float(np.nanpercentile(ttft, 50)),
+        "ttft_p99_ms": float(np.nanpercentile(ttft, 99)),
+        "requests": args.n,
+        "dropped": dropped,
+        "wall_s": wall,
+        "total_tokens": n_tokens,
+        "preemptions": st["preemptions"],
+        "compiled_programs": st["compiled_programs"],
+        "mode": "engine",
+    }
+
+
+def bench_serve_deployment(args) -> dict:
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import build_llm_app
+
+    ray_tpu.init(num_cpus=8)
+    handle = serve.run(build_llm_app(
+        model=args.model, preset=args.preset,
+        engine_config={"block_size": 16,
+                       "max_model_len": args.max_model_len,
+                       "max_batch_size": args.batch,
+                       "num_blocks": args.num_blocks}), name="bench-llm")
+    sh = handle.options(stream=True, generator_backpressure=128)
+    prompts = _requests(args.n, seed=0, max_len=args.max_model_len // 2)
+    # warm-up
+    for r in sh.remote({"prompt": prompts[0], "max_tokens": 2}):
+        ray_tpu.get(r, timeout=300)
+
+    results = [None] * args.n
+    ttft = [float("nan")] * args.n
+
+    def consume(i, gen, te):
+        events = []
+        for r in gen:
+            events.append(ray_tpu.get(r, timeout=300))
+            if len(events) == 1:
+                ttft[i] = (time.monotonic() - te) * 1e3
+        results[i] = events[-1]
+
+    rng = np.random.RandomState(1)
+    threads = []
+    t0 = time.monotonic()
+    for i, p in enumerate(prompts):
+        te = time.monotonic()
+        gen = sh.remote({"prompt": p, "max_tokens": args.max_tokens})
+        th = threading.Thread(target=consume, args=(i, gen, te),
+                              daemon=True)
+        th.start()
+        threads.append(th)
+        time.sleep(float(rng.exponential(1.0 / args.rate)))
+    for th in threads:
+        th.join(timeout=300)
+    wall = time.monotonic() - t0
+
+    n_tokens = sum(r["num_generated"] for r in results if r)
+    dropped = sum(1 for r in results if not r)
+    serve.delete("bench-llm")
+    return {
+        "tokens_per_sec": n_tokens / wall,
+        "ttft_p50_ms": float(np.nanpercentile(ttft, 50)),
+        "ttft_p99_ms": float(np.nanpercentile(ttft, 99)),
+        "requests": args.n,
+        "dropped": dropped,
+        "wall_s": wall,
+        "total_tokens": n_tokens,
+        "mode": "serve",
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=64)
+    ap.add_argument("--rate", type=float, default=8.0,
+                    help="open-loop arrival rate, req/s")
+    ap.add_argument("--model", default="gpt2")
+    ap.add_argument("--preset", default="tiny")
+    ap.add_argument("--max-tokens", type=int, default=16)
+    ap.add_argument("--max-model-len", type=int, default=128)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--num-blocks", type=int, default=None)
+    ap.add_argument("--serve", action="store_true")
+    args = ap.parse_args()
+
+    extra = bench_serve_deployment(args) if args.serve \
+        else bench_engine(args)
+    out = {
+        "metric": "serve_llm_tokens_per_sec",
+        "value": round(extra["tokens_per_sec"], 1),
+        "unit": "tokens/s",
+        "secondary_metrics": [
+            {"metric": "serve_llm_ttft_p50", "unit": "ms",
+             "value": round(extra["ttft_p50_ms"], 1)},
+            {"metric": "serve_llm_ttft_p99", "unit": "ms",
+             "value": round(extra["ttft_p99_ms"], 1)},
+        ],
+        "extra": extra,
+    }
+    print(json.dumps(out))
+    with open("SERVE_BENCH.json", "w") as f:
+        json.dump(out, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -11,4 +11,5 @@ from ray_tpu.devtools.rules import (  # noqa: F401
     guarded_by,
     host_transfer,
     spmd_nondeterminism,
+    store_refcount,
 )

@@ -1,0 +1,101 @@
+"""GL007: object-store ``get()`` without a matching ``release()``.
+
+``ObjectStore.get`` returns a zero-copy view that HOLDS A REFCOUNT —
+the store cannot evict the object until ``release(oid)`` drops it
+(``ray_tpu/core/object_store.py``). A function that calls
+``<store>.get(...)`` and never calls ``<store>.release(...)`` leaks
+that pin: under memory pressure the allocator sees phantom live
+objects, eviction stalls, and puts start failing with
+ObjectStoreFullError long before the store is actually full.
+
+Heuristic scope is the enclosing function and the exact receiver
+expression: a ``self.store.get(oid)`` needs a ``self.store.release(...)``
+somewhere in the same function. Receivers are considered store-like
+when the attribute/name path ends in ``store`` (``store``,
+``self.store``, ``self._store``, ``node.obj_store``); plain dict/queue
+``.get`` calls never match — additionally the call must take exactly
+one non-string-literal argument (an oid), so ``store.get("key", {})``
+on a dict that merely happens to be NAMED store stays quiet. Two sanctioned hand-off conventions are
+honored (mirroring GL005's caller-holds-the-lock conventions):
+
+- a docstring (of any enclosing function) containing
+  ``caller releases`` — the view is returned and ownership moves up;
+- a function name ending in ``_unreleased``.
+
+Anything else intentional gets a justified
+``# graftlint: disable=unreleased-store-ref`` at the call site.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ray_tpu.devtools.context import ModuleContext, qualname
+from ray_tpu.devtools.registry import Rule, register
+
+_STORE_RE = re.compile(r"(^|[._])store$")
+
+
+def _store_receiver(call: ast.Call) -> str | None:
+    """The dotted receiver of a `<recv>.get(...)`/`<recv>.release(...)`
+    call when `<recv>` looks like an object store, else None."""
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    recv = qualname(call.func.value)
+    if recv is None or not _STORE_RE.search(recv):
+        return None
+    return recv
+
+
+@register
+class StoreRefcountRule(Rule):
+    name = "unreleased-store-ref"
+    code = "GL007"
+    description = ("object-store get() whose refcount pin has no "
+                   "matching release() in the function")
+    invariant = ("every store.get() view is released, so eviction is "
+                 "never stalled by phantom pins")
+    interests = ("Call",)
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        # (func node) -> set of receivers released in that function
+        self._released: dict[ast.AST, set[str]] = {}
+        # deferred get() events: (recv, node, func, docstring stack)
+        self._gets: list[tuple] = []
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            return
+        fn = ctx.current_function
+        if fn is None:
+            return
+        if node.func.attr == "release":
+            recv = _store_receiver(node)
+            if recv is not None:
+                for f in ctx.func_stack:
+                    self._released.setdefault(f, set()).add(recv)
+        elif (node.func.attr == "get" and len(node.args) == 1
+              and not (isinstance(node.args[0], ast.Constant)
+                       and isinstance(node.args[0].value, str))):
+            recv = _store_receiver(node)
+            if recv is not None:
+                docs = [(f.name,
+                         (ast.get_docstring(f, clean=False) or "").lower())
+                        for f in ctx.func_stack]
+                self._gets.append((recv, node, fn, docs))
+
+    def end_module(self, ctx: ModuleContext) -> None:
+        for recv, node, fn, docs in self._gets:
+            if recv in self._released.get(fn, ()):
+                continue
+            if any(name.endswith("_unreleased") or "caller releases" in doc
+                   for name, doc in docs):
+                continue
+            fn_name = docs[-1][0] if docs else "?"
+            ctx.report(self, node,
+                       f"{recv}.get() holds a refcount but {fn_name} "
+                       f"never calls {recv}.release(); the pin leaks "
+                       f"and stalls eviction (hand off with a 'caller "
+                       f"releases' docstring if ownership moves up)")

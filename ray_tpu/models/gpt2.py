@@ -154,8 +154,11 @@ def _layer_norm(x, scale, bias, eps=1e-5):
     return (y * scale + bias).astype(x.dtype)
 
 
-def _block(x, p, cfg: GPT2Config):
-    """One transformer block. `p` holds this layer's (unstacked) params."""
+def _block_kv(x, p, cfg: GPT2Config):
+    """One transformer block. `p` holds this layer's (unstacked) params.
+    Also returns this layer's attention K/V heads (B, T, H, D) so
+    prefill (serve.llm) can seed a KV cache from the same math the
+    training forward uses."""
     B, T, E = x.shape
     dt = cfg.dtype
     h = _layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"])
@@ -166,7 +169,8 @@ def _block(x, p, cfg: GPT2Config):
     def heads(t):
         return t.reshape(B, T, cfg.n_head, cfg.head_dim)
 
-    att = causal_attention(heads(q), heads(kk), heads(v))
+    k_h, v_h = heads(kk), heads(v)
+    att = causal_attention(heads(q), k_h, v_h)
     att = att.reshape(B, T, E)
     att = att @ p["attn_proj"]["kernel"].astype(dt) + p["attn_proj"]["bias"].astype(dt)
     x = x + constrain(att, ("data", "fsdp"), None, None)
@@ -177,7 +181,11 @@ def _block(x, p, cfg: GPT2Config):
     h = jax.nn.gelu(h)
     h = h @ p["mlp_proj"]["kernel"].astype(dt) + p["mlp_proj"]["bias"].astype(dt)
     x = x + constrain(h, ("data", "fsdp"), None, None)
-    return x
+    return x, (k_h, v_h)
+
+
+def _block(x, p, cfg: GPT2Config):
+    return _block_kv(x, p, cfg)[0]
 
 
 def gpt2_forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
@@ -250,6 +258,102 @@ def gpt2_loss(params: Params, batch: dict, cfg: GPT2Config) -> jax.Array:
     if weights is None:
         return -jnp.mean(ll)
     return -jnp.sum(ll * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+
+
+# --------------------------------------------------------------------------
+# KV-cache inference steps (serve.llm). Prefill runs the full-sequence
+# forward and additionally returns every layer's K/V heads; decode runs
+# ONE token per sequence against externally gathered context K/V (the
+# paged-cache gather/scatter lives in ray_tpu/serve/llm/runner.py — the
+# model layer only owns the math, so parity with the training forward is
+# checkable function-against-function).
+
+
+def gpt2_prefill_kv(
+    params: Params, tokens: jax.Array, cfg: GPT2Config
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """tokens (B, T) -> (logits (B, T, Vp) f32, k, v (L, B, T, H, D))."""
+    B, T = tokens.shape
+    dt = cfg.dtype
+    wte = constrain(params["wte"].astype(dt), None, None)
+    x = wte[tokens] + params["wpe"].astype(dt)[:T]
+    x = constrain(x, ("data", "fsdp"), None, None)
+
+    def body(carry, layer_params):
+        y, (k, v) = _block_kv(carry, layer_params, cfg)
+        return y, (k, v)
+
+    x, (k, v) = jax.lax.scan(body, x, params["blocks"])
+    x = _layer_norm(x, params["lnf"]["scale"], params["lnf"]["bias"])
+    logits = x @ params["wte"].astype(dt).T
+    logits = constrain(logits, ("data", "fsdp"), None, "tensor")
+    return logits.astype(jnp.float32), k, v
+
+
+def _decode_block(x, p, k_ctx, v_ctx, ctx_mask, cfg: GPT2Config):
+    """Single-token block step. x (B, E); k_ctx/v_ctx (B, C, H, D) hold
+    the sequence's cached context (padded; ctx_mask (B, C) marks valid
+    slots). Returns (x, (k_new, v_new)) with k_new/v_new (B, H, D)."""
+    B, E = x.shape
+    dt = cfg.dtype
+    H, D = cfg.n_head, cfg.head_dim
+    h = _layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"])
+    qkv = h @ p["attn_qkv"]["kernel"].astype(dt) + p["attn_qkv"]["bias"].astype(dt)
+    qkv = constrain(qkv, ("data", "fsdp"), "tensor")
+    q, k, v = (t.reshape(B, H, D) for t in jnp.split(qkv, 3, axis=-1))
+
+    scale = 1.0 / (D**0.5)
+    # context scores + the token's own (diagonal) score, softmax in f32
+    s_ctx = jnp.einsum("bhd,bchd->bhc", q, k_ctx).astype(jnp.float32)
+    s_own = jnp.sum(q * k, axis=-1, dtype=jnp.float32)
+    s = jnp.concatenate([s_ctx, s_own[:, :, None]], axis=-1) * scale
+    valid = jnp.concatenate(
+        [ctx_mask, jnp.ones((B, 1), dtype=bool)], axis=-1)
+    s = jnp.where(valid[:, None, :], s, -1e30)
+    probs = jax.nn.softmax(s, axis=-1).astype(dt)
+    att = jnp.einsum("bhc,bchd->bhd", probs[..., :-1], v_ctx) \
+        + probs[..., -1:] * v
+    att = att.reshape(B, E)
+    att = att @ p["attn_proj"]["kernel"].astype(dt) + p["attn_proj"]["bias"].astype(dt)
+    x = x + constrain(att, ("data", "fsdp"), None)
+
+    h = _layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+    h = h @ p["mlp_fc"]["kernel"].astype(dt) + p["mlp_fc"]["bias"].astype(dt)
+    h = constrain(h, ("data", "fsdp"), "tensor")
+    h = jax.nn.gelu(h)
+    h = h @ p["mlp_proj"]["kernel"].astype(dt) + p["mlp_proj"]["bias"].astype(dt)
+    x = x + constrain(h, ("data", "fsdp"), None)
+    return x, (k, v)
+
+
+def gpt2_decode_kv(
+    params: Params,
+    tokens: jax.Array,
+    positions: jax.Array,
+    k_ctx: jax.Array,
+    v_ctx: jax.Array,
+    ctx_mask: jax.Array,
+    cfg: GPT2Config,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step for a batch of sequences.
+
+    tokens/positions (B,) i32; k_ctx/v_ctx (L, B, C, H, D) gathered
+    cache context; ctx_mask (B, C). Returns (logits (B, Vp) f32,
+    k_new, v_new (L, B, H, D)) — the caller scatters k_new/v_new into
+    the cache at each sequence's current position.
+    """
+    dt = cfg.dtype
+    x = params["wte"].astype(dt)[tokens] + params["wpe"].astype(dt)[positions]
+
+    def body(carry, xs):
+        p, kc, vc = xs
+        return _decode_block(carry, p, kc, vc, ctx_mask, cfg)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["blocks"], k_ctx, v_ctx))
+    x = _layer_norm(x, params["lnf"]["scale"], params["lnf"]["bias"])
+    logits = x @ params["wte"].astype(dt).T
+    return logits.astype(jnp.float32), k_new, v_new
 
 
 def count_params(params: Params) -> int:

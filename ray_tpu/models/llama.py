@@ -130,7 +130,26 @@ def _rope(x, theta: float):
     return rotated.astype(x.dtype)
 
 
-def _block(x, p, cfg: LlamaConfig):
+def _rope_at(x, positions, theta: float):
+    """Rotary embedding for single-token decode: x (B, H, D) rotated by
+    each sequence's absolute position (B,). Same formula as `_rope`, so
+    cached prefill K and decode K agree bit-for-bit per position."""
+    B, H, D = x.shape
+    half = D // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[:, None, :]
+    sin = jnp.sin(angles)[:, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+def _block_kv(x, p, cfg: LlamaConfig):
+    """One block; also returns post-rope, pre-GQA-replication K/V heads
+    (B, T, H_kv, D) — the layout serve.llm caches (decode replicates at
+    attention time, like the forward path)."""
     B, T, E = x.shape
     dt = cfg.dtype
     hd = cfg.head_dim
@@ -141,6 +160,7 @@ def _block(x, p, cfg: LlamaConfig):
     v = (h @ p["wv"].astype(dt)).reshape(B, T, cfg.n_kv_head, hd)
     q = _rope(q, cfg.rope_theta)
     k = _rope(k, cfg.rope_theta)
+    k_cache, v_cache = k, v
     # GQA: replicate K/V heads up to n_head (reference semantics of
     # repeat_kv; XLA turns the broadcast into reuse, no materialized copy
     # survives fusion)
@@ -157,7 +177,11 @@ def _block(x, p, cfg: LlamaConfig):
     gate = constrain(gate, ("data", "fsdp"), None, "tensor")
     h = (jax.nn.silu(gate) * up) @ p["w_down"].astype(dt)
     x = x + constrain(h, ("data", "fsdp"), None, None)
-    return x
+    return x, (k_cache, v_cache)
+
+
+def _block(x, p, cfg: LlamaConfig):
+    return _block_kv(x, p, cfg)[0]
 
 
 def llama_forward(params: Params, tokens: jax.Array,
@@ -181,6 +205,103 @@ def llama_forward(params: Params, tokens: jax.Array,
     logits = x @ params["wte"].astype(dt).T
     logits = constrain(logits, ("data", "fsdp"), None, "tensor")
     return logits.astype(jnp.float32)
+
+
+# --------------------------------------------------------------------------
+# KV-cache inference steps (serve.llm) — see models/gpt2.py for the
+# layering contract: models own the math, serve/llm/runner.py owns the
+# paged gather/scatter. K is cached POST-rope with n_kv_head heads.
+
+
+def llama_prefill_kv(
+    params: Params, tokens: jax.Array, cfg: LlamaConfig
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """tokens (B, T) -> (logits (B, T, Vp) f32, k, v (L, B, T, Hkv, D))."""
+    dt = cfg.dtype
+    wte = constrain(params["wte"].astype(dt), None, None)
+    x = wte[tokens]
+    x = constrain(x, ("data", "fsdp"), None, None)
+
+    def body(carry, layer_params):
+        y, (k, v) = _block_kv(carry, layer_params, cfg)
+        return y, (k, v)
+
+    x, (k, v) = jax.lax.scan(body, x, params["blocks"])
+    x = _rmsnorm(x, params["lnf"], cfg.rms_eps)
+    logits = x @ params["wte"].astype(dt).T
+    logits = constrain(logits, ("data", "fsdp"), None, "tensor")
+    return logits.astype(jnp.float32), k, v
+
+
+def _decode_block(x, p, k_ctx, v_ctx, ctx_mask, positions, cfg: LlamaConfig):
+    """Single-token block step; x (B, E), k_ctx/v_ctx (B, C, Hkv, D)
+    post-rope cached context, ctx_mask (B, C), positions (B,).
+    Returns (x, (k_new, v_new)) with k_new/v_new (B, Hkv, D)."""
+    B, E = x.shape
+    dt = cfg.dtype
+    hd = cfg.head_dim
+    H, HK = cfg.n_head, cfg.n_kv_head
+
+    h = _rmsnorm(x, p["ln_attn"], cfg.rms_eps)
+    q = (h @ p["wq"].astype(dt)).reshape(B, H, hd)
+    k = (h @ p["wk"].astype(dt)).reshape(B, HK, hd)
+    v = (h @ p["wv"].astype(dt)).reshape(B, HK, hd)
+    q = _rope_at(q, positions, cfg.rope_theta)
+    k = _rope_at(k, positions, cfg.rope_theta)
+
+    rep = H // HK
+    kce = jnp.repeat(k_ctx, rep, axis=2)
+    vce = jnp.repeat(v_ctx, rep, axis=2)
+    ke = jnp.repeat(k, rep, axis=1)
+    ve = jnp.repeat(v, rep, axis=1)
+
+    scale = 1.0 / (hd**0.5)
+    s_ctx = jnp.einsum("bhd,bchd->bhc", q, kce).astype(jnp.float32)
+    s_own = jnp.sum(q * ke, axis=-1, dtype=jnp.float32)
+    s = jnp.concatenate([s_ctx, s_own[:, :, None]], axis=-1) * scale
+    valid = jnp.concatenate(
+        [ctx_mask, jnp.ones((B, 1), dtype=bool)], axis=-1)
+    s = jnp.where(valid[:, None, :], s, -1e30)
+    probs = jax.nn.softmax(s, axis=-1).astype(dt)
+    att = jnp.einsum("bhc,bchd->bhd", probs[..., :-1], vce) \
+        + probs[..., -1:] * ve
+    att = att.reshape(B, E) @ p["wo"].astype(dt)
+    x = x + constrain(att, ("data", "fsdp"), None)
+
+    h = _rmsnorm(x, p["ln_mlp"], cfg.rms_eps)
+    gate = h @ p["w_gate"].astype(dt)
+    up = h @ p["w_up"].astype(dt)
+    gate = constrain(gate, ("data", "fsdp"), "tensor")
+    x = x + constrain(
+        (jax.nn.silu(gate) * up) @ p["w_down"].astype(dt),
+        ("data", "fsdp"), None)
+    return x, (k, v)
+
+
+def llama_decode_kv(
+    params: Params,
+    tokens: jax.Array,
+    positions: jax.Array,
+    k_ctx: jax.Array,
+    v_ctx: jax.Array,
+    ctx_mask: jax.Array,
+    cfg: LlamaConfig,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step; see gpt2_decode_kv. k_ctx/v_ctx are
+    (L, B, C, Hkv, D); returns (logits (B, Vp) f32, k_new, v_new
+    (L, B, Hkv, D))."""
+    dt = cfg.dtype
+    x = params["wte"].astype(dt)[tokens]
+
+    def body(carry, xs):
+        p, kc, vc = xs
+        return _decode_block(carry, p, kc, vc, ctx_mask, positions, cfg)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["blocks"], k_ctx, v_ctx))
+    x = _rmsnorm(x, params["lnf"], cfg.rms_eps)
+    logits = x @ params["wte"].astype(dt).T
+    return logits.astype(jnp.float32), k_new, v_new
 
 
 def llama_loss(params: Params, batch: dict, cfg: LlamaConfig) -> jax.Array:

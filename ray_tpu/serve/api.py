@@ -244,8 +244,13 @@ class ServeController:
         opts = dict(app["actor_options"] or {})
         opts.setdefault("num_cpus", 0.1)
         cls = ray_tpu.remote(**opts)(_Replica)
+        # control-plane probes (ongoing/ping/engine stats) ride their own
+        # executor lane so they never queue behind long-running request
+        # streams (an LLM token stream can hold a default-lane thread for
+        # minutes)
         return cls.options(
-            max_concurrency=max(2, app["max_concurrency"])).remote(
+            max_concurrency=max(2, app["max_concurrency"]),
+            concurrency_groups={"control": 2}).remote(
             app["cls_blob"], app["init_args"], app["init_kwargs"])
 
     def _publish_update(self, app_name: str):
@@ -308,7 +313,9 @@ class ServeController:
                 replicas = app["replicas"]
                 try:
                     loads = ray_tpu.get(
-                        [r.ongoing.remote() for r in replicas], timeout=10)
+                        [r.ongoing.options(
+                            concurrency_group="control").remote()
+                         for r in replicas], timeout=10)
                 except Exception:  # noqa: BLE001
                     continue
                 mean = sum(loads) / max(1, len(loads))
@@ -357,7 +364,9 @@ class ServeController:
         deadline = _t.monotonic() + timeout
         while _t.monotonic() < deadline:
             try:
-                if ray_tpu.get(replica.ongoing.remote(), timeout=10) == 0:
+                if ray_tpu.get(replica.ongoing.options(
+                        concurrency_group="control").remote(),
+                        timeout=10) == 0:
                     break
             except Exception:  # noqa: BLE001
                 break
@@ -459,8 +468,10 @@ class DeploymentHandle:
             return self._replicas[0]
         a, b = random.sample(self._replicas, 2)
         try:
-            qa, qb = ray_tpu.get([a.ongoing.remote(), b.ongoing.remote()],
-                                 timeout=5)
+            qa, qb = ray_tpu.get(
+                [a.ongoing.options(concurrency_group="control").remote(),
+                 b.ongoing.options(concurrency_group="control").remote()],
+                timeout=5)
             return a if qa <= qb else b
         except Exception:  # noqa: BLE001
             with self._lock:
@@ -476,30 +487,44 @@ class DeploymentHandle:
 
         return call
 
-    def options(self, *, stream: bool = False) -> "DeploymentHandle":
+    def options(self, *, stream: bool = False,
+                generator_backpressure: int | None = None
+                ) -> "DeploymentHandle":
         """stream=True: calls return an ObjectRefGenerator — one ref per
         chunk the deployment yields, delivered as produced (reference:
-        handle.options(stream=True), serve/handle.py)."""
+        handle.options(stream=True), serve/handle.py).
+        `generator_backpressure` caps yielded-but-unconsumed chunks
+        before the replica blocks — a slow stream consumer (an LLM
+        client reading tokens at human speed) must not buffer an
+        unbounded queue on the replica."""
         if not stream:
             return self
-        return _StreamingHandle(self)
+        return _StreamingHandle(self, generator_backpressure)
 
 
 class _StreamingHandle:
     """View over a DeploymentHandle whose calls ride the streaming
     generator protocol (chunks consumable before the handler returns)."""
 
-    def __init__(self, base: DeploymentHandle):
+    def __init__(self, base: DeploymentHandle,
+                 backpressure: int | None = None):
         self._base = base
+        self._backpressure = backpressure
+
+    def _opts(self):
+        o = {"num_returns": "streaming"}
+        if self._backpressure:
+            o["generator_backpressure_num_objects"] = self._backpressure
+        return o
 
     def remote(self, *args, **kwargs):
         return self._base._pick().handle_stream_request.options(
-            num_returns="streaming").remote("__call__", args, kwargs)
+            **self._opts()).remote("__call__", args, kwargs)
 
     def method(self, name: str):
         def call(*args, **kwargs):
             return self._base._pick().handle_stream_request.options(
-                num_returns="streaming").remote(name, args, kwargs)
+                **self._opts()).remote(name, args, kwargs)
 
         return call
 

@@ -1,0 +1,47 @@
+"""ray_tpu.serve.llm — TPU-native continuous-batching LLM inference.
+
+The serving counterpart of ray.serve's LLM stack, built jax-first:
+
+- a **block KV-cache pool** (`cache.py`): fixed-size pages over one
+  device array per model, a free-list allocator, and per-sequence block
+  tables — page 0 is a reserved null sink so padded lanes always have a
+  legal scatter/gather target;
+- jit-compiled **prefill and single-token decode** steps (`runner.py`)
+  for the gpt2 and llama model families, with length-bucketed padding
+  so the number of compiled programs stays bounded, sharded through the
+  models' own `parallel/sharding.py` partition rules when a mesh is
+  given;
+- a **continuous-batching scheduler** (`scheduler.py`): admission
+  queue, prefill/decode interleaving, recompute-style preemption +
+  requeue when the cache pool is exhausted, EOS / max-tokens
+  completion;
+- an **engine** (`engine.py`) gluing the three together, streaming
+  tokens per request and exporting serving metrics (tokens/s, TTFT,
+  queue depth, cache utilization) through `ray_tpu.util.metrics`;
+- a **serve deployment** (`deployment.py`): `@serve.deployment`
+  replicas each own one engine plus its step-loop thread, and
+  `DeploymentHandle.options(stream=True)` streams tokens back.
+
+See SERVING.md for the architecture walkthrough.
+"""
+
+from ray_tpu.serve.llm.cache import BlockPool
+from ray_tpu.serve.llm.config import EngineConfig, SamplingParams
+from ray_tpu.serve.llm.deployment import LLMServer, build_llm_app
+from ray_tpu.serve.llm.engine import LLMEngine, RequestStream
+from ray_tpu.serve.llm.runner import ModelRunner
+from ray_tpu.serve.llm.scheduler import Scheduler, Sequence, SeqState
+
+__all__ = [
+    "BlockPool",
+    "EngineConfig",
+    "LLMEngine",
+    "LLMServer",
+    "ModelRunner",
+    "RequestStream",
+    "SamplingParams",
+    "Scheduler",
+    "SeqState",
+    "Sequence",
+    "build_llm_app",
+]

@@ -1,0 +1,133 @@
+"""Block KV-cache pool: fixed-size pages + free-list allocator.
+
+The device arrays themselves live in the ModelRunner (one K and one V
+array of shape (L, num_blocks, block_size, H_kv, D) per model); this
+module owns the *bookkeeping*: which physical pages are free, and each
+sequence's logical-block -> physical-page table.
+
+Page 0 is reserved as a **null sink**: it is never handed out, padded
+lanes of a bucketed batch point their tables at it, and padded prefill
+positions scatter into it. Gathers through a padded table therefore
+always hit a legal page, and the attention mask (not the allocator)
+is what keeps garbage out of the softmax.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class CacheExhausted(Exception):
+    """Raised by alloc() when the pool cannot satisfy a request; the
+    scheduler turns this into preemption, not an error."""
+
+
+class BlockPool:
+    """Free-list allocator over `num_blocks` physical KV pages.
+
+    Thread-safe: the engine's step loop allocates while request threads
+    release on abort.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (page 0 is the null sink)")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._lock = threading.Lock()
+        # page 0 reserved; LIFO free list keeps hot pages hot
+        self._free: list[int] = list(range(num_blocks - 1, 0, -1))  # guarded_by(_lock)
+        self._free_set: set[int] = set(self._free)  # guarded_by(_lock)
+
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - 1
+
+    def num_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def num_used(self) -> int:
+        return self.usable_blocks - self.num_free()
+
+    def utilization(self) -> float:
+        return self.num_used() / max(1, self.usable_blocks)
+
+    def blocks_for_tokens(self, n_tokens: int) -> int:
+        """Pages needed to hold positions 0..n_tokens-1."""
+        return (n_tokens + self.block_size - 1) // self.block_size
+
+    def can_alloc(self, n: int) -> bool:
+        with self._lock:
+            return len(self._free) >= n
+
+    def alloc(self, n: int) -> list[int]:
+        """Pop `n` pages or raise CacheExhausted (all-or-nothing)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        with self._lock:
+            if len(self._free) < n:
+                raise CacheExhausted(
+                    f"need {n} blocks, {len(self._free)} free")
+            out = self._free[-n:] if n else []
+            del self._free[len(self._free) - n:]
+            self._free_set.difference_update(out)
+            return out
+
+    def free(self, blocks: list[int]) -> None:
+        if not blocks:
+            return
+        with self._lock:
+            for b in blocks:
+                if not 0 < b < self.num_blocks:
+                    raise ValueError(f"free of invalid block {b}")
+                if b in self._free_set:
+                    raise ValueError(f"double free of block {b}")
+            self._free.extend(blocks)
+            self._free_set.update(blocks)
+
+
+def auto_num_blocks(
+    *,
+    n_layer: int,
+    n_kv_head: int,
+    head_dim: int,
+    block_size: int,
+    dtype_bytes: int,
+    max_model_len: int,
+    max_batch_size: int,
+    memory_fraction: float = 0.3,
+    tensor_ways: int = 1,
+    device=None,
+) -> int:
+    """Size the pool off device memory (reference: vLLM's gpu memory
+    profiling, here a static estimate: params are already resident, so
+    take `memory_fraction` of the device's bytes_limit for KV).
+
+    Falls back to "every lane can reach max_model_len, twice over" when
+    the backend doesn't report memory (CPU jax in tests).
+    """
+    # mirror the runner's sharding rule: pages shard over `tensor` only
+    # when the KV heads divide evenly, otherwise they are replicated —
+    # sizing must not assume a split the runner won't make
+    if tensor_ways > 1 and n_kv_head % tensor_ways == 0:
+        heads_per_shard = n_kv_head // tensor_ways
+    else:
+        heads_per_shard = n_kv_head
+    per_block = 2 * n_layer * block_size * heads_per_shard \
+        * head_dim * dtype_bytes
+    budget = None
+    if device is None:
+        import jax
+
+        device = jax.local_devices()[0]
+    try:
+        stats = device.memory_stats()
+        if stats:
+            budget = int(stats.get("bytes_limit", 0) * memory_fraction)
+    except Exception:  # noqa: BLE001  (CPU backend: no memory_stats)
+        budget = None
+    floor = max_batch_size * ((max_model_len + block_size - 1) // block_size)
+    if not budget:
+        return 2 * floor + 1  # +1: the null page
+    return max(floor + 1, budget // per_block)

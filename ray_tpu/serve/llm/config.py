@@ -1,0 +1,80 @@
+"""Engine and per-request sampling configuration.
+
+`EngineConfig` is deliberately a plain dataclass of primitives (plus an
+optional concrete model config object) so it round-trips through
+cloudpickle into serve replicas and through JSON into HTTP payloads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """Per-request decode controls (reference: vLLM SamplingParams,
+    trimmed to what the runner implements in-jit)."""
+
+    max_tokens: int = 16
+    temperature: float = 0.0  # 0 => greedy argmax
+    eos_token_id: int | Sequence[int] | None = None
+    # include prompt token ids in the final output event (debug aid)
+    echo: bool = False
+
+    def __post_init__(self):
+        if self.max_tokens < 1:
+            raise ValueError(
+                f"max_tokens must be >= 1, got {self.max_tokens} "
+                "(prefill always yields the first token)")
+        if self.temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got "
+                             f"{self.temperature}")
+
+    def eos_set(self) -> frozenset[int]:
+        if self.eos_token_id is None:
+            return frozenset()
+        if isinstance(self.eos_token_id, int):
+            return frozenset((self.eos_token_id,))
+        return frozenset(int(t) for t in self.eos_token_id)
+
+    @staticmethod
+    def from_payload(d: dict | None) -> "SamplingParams":
+        d = d or {}
+        return SamplingParams(
+            max_tokens=int(d.get("max_tokens", 16)),
+            temperature=float(d.get("temperature", 0.0)),
+            eos_token_id=d.get("eos_token_id"),
+            echo=bool(d.get("echo", False)))
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Engine shape. `num_blocks=None` sizes the pool off device memory
+    (`cache.auto_num_blocks`); tests pass small explicit pools to force
+    preemption."""
+
+    model: str = "gpt2"  # adapter key: "gpt2" | "llama"
+    preset: str = "tiny"  # model-config preset name on the config class
+    model_config: Any = None  # overrides preset when given
+    block_size: int = 16  # tokens per KV page
+    num_blocks: int | None = None  # physical pages incl. the null page
+    memory_fraction: float = 0.3  # of device memory, when auto-sizing
+    max_model_len: int | None = None  # default: model cfg block_size
+    max_batch_size: int = 8  # concurrent decode lanes
+    prefill_bucket_min: int = 16
+    seed: int = 0  # weight init seed when no params are passed
+
+    def __post_init__(self):
+        if self.block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        if self.max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+
+    @staticmethod
+    def from_dict(d: dict) -> "EngineConfig":
+        known = {f.name for f in dataclasses.fields(EngineConfig)}
+        bad = set(d) - known
+        if bad:
+            raise ValueError(f"unknown EngineConfig keys: {sorted(bad)}")
+        return EngineConfig(**d)

@@ -1,0 +1,119 @@
+"""Serve integration: LLM engine replicas behind a DeploymentHandle.
+
+Each replica of the deployment owns one `LLMEngine` plus a daemon
+step-loop thread; `__call__` is a generator, so callers stream tokens
+through ``handle.options(stream=True).remote(payload)`` (one ObjectRef
+per token event) or over the HTTP proxy's NDJSON path — the same
+streaming generator protocol every other serve deployment uses.
+
+Payload schema (JSON-friendly)::
+
+    {"prompt": [1, 2, 3],          # token ids (no tokenizer in-repo)
+     "max_tokens": 16,
+     "temperature": 0.0,
+     "eos_token_id": null | int | [int, ...],
+     "echo": false,
+     "stream": true}               # false: single final event only
+
+Engine stats ride the replica's ``control`` concurrency group so probes
+don't queue behind long-running token streams.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from ray_tpu.serve.llm.config import EngineConfig, SamplingParams
+
+
+class LLMServer:
+    """Deployment class: one engine per replica (use via
+    `build_llm_app`, or wrap with `serve.deployment` yourself)."""
+
+    def __init__(self, engine_config: dict | EngineConfig | None = None,
+                 warmup: bool = True, **cfg_kwargs):
+        from ray_tpu.serve.llm.engine import LLMEngine
+
+        if isinstance(engine_config, EngineConfig):
+            cfg = engine_config
+        else:
+            merged = dict(engine_config or {})
+            merged.update(cfg_kwargs)
+            cfg = EngineConfig.from_dict(merged)
+        self.engine = LLMEngine(cfg)
+        if warmup:
+            # replicas come up hot: every bucketed program compiles
+            # before the controller's readiness barrier passes, so the
+            # first real request never eats an XLA compile
+            self.engine.warmup()
+        self._alive = True
+        self._loop = threading.Thread(
+            target=self._step_loop, daemon=True, name="llm-engine-loop")
+        self._loop.start()
+
+    def _step_loop(self):
+        import time
+
+        while self._alive:
+            if not self.engine.step():
+                time.sleep(0.002)  # idle: nothing queued or running
+
+    def __call__(self, payload: dict | None):
+        payload = payload or {}
+        prompt = payload.get("prompt")
+        if not prompt:
+            raise ValueError("payload needs a non-empty 'prompt' "
+                             "(list of token ids)")
+        sampling = SamplingParams.from_payload(payload)
+        stream = self.engine.add_request(prompt, sampling)
+        try:
+            if payload.get("stream", True):
+                yield from stream
+            else:
+                for _ in stream:
+                    pass
+            yield stream.final()
+        finally:
+            # consumer gone mid-stream (GeneratorExit / replica
+            # teardown): release the decode lane + KV pages instead of
+            # generating to max_tokens for nobody
+            if stream.final() is None:
+                self.engine.abort_request(stream, "client_disconnected")
+
+    def engine_stats(self) -> dict:
+        return self.engine.stats()
+
+    def ping(self) -> str:
+        return "pong"
+
+    def shutdown_engine(self) -> bool:
+        self._alive = False
+        return True
+
+
+def build_llm_app(
+    *,
+    model: str = "gpt2",
+    preset: str = "tiny",
+    num_replicas: int = 1,
+    engine_config: dict | None = None,
+    max_ongoing_requests: int = 32,
+    ray_actor_options: dict | None = None,
+) -> Any:
+    """Bind an LLM application: ``serve.run(build_llm_app(...))``.
+
+    `engine_config` entries override the model/preset shorthand."""
+    from ray_tpu import serve
+
+    cfg = {"model": model, "preset": preset}
+    cfg.update(engine_config or {})
+    EngineConfig.from_dict(cfg)  # validate in the driver, not the replica
+    dep = serve.deployment(
+        LLMServer,
+        name=f"llm-{cfg['model']}",
+        num_replicas=num_replicas,
+        max_ongoing_requests=max_ongoing_requests,
+        ray_actor_options=ray_actor_options,
+    )
+    return dep.bind(cfg)

@@ -1,0 +1,407 @@
+"""LLMEngine: cache pool + runner + scheduler + streaming outputs.
+
+One engine instance serves one model replica. Requests arrive from any
+thread (`add_request` / `generate`); exactly one thread drives
+`step()` (the serve deployment runs a daemon step loop; tests call
+`step()` inline). Each request gets a `RequestStream` — an iterator of
+token events fed by the step loop and closed with a final summary
+event.
+
+Engine metrics flow through `ray_tpu.util.metrics`, so every replica's
+numbers land on the process /metrics surface the dashboard scrapes:
+tokens/s, TTFT, per-step latency, queue depth, cache utilization,
+preemptions.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from typing import Any, Sequence as Seq
+
+from ray_tpu.serve.llm.cache import BlockPool, auto_num_blocks
+from ray_tpu.serve.llm.config import EngineConfig, SamplingParams
+from ray_tpu.serve.llm.runner import DecodeItem, ModelRunner, adapters
+from ray_tpu.serve.llm.scheduler import (
+    DecodeWork,
+    PrefillWork,
+    Scheduler,
+    Sequence,
+)
+
+_FINAL = object()
+
+
+class RequestStream:
+    """Iterator over one request's token events.
+
+    Yields ``{"token": id, "index": n}`` dicts as tokens are produced,
+    then raises StopIteration; `final()` returns the summary event
+    (token_ids, finish_reason, counts) once the stream is drained."""
+
+    def __init__(self, seq_id: int):
+        self.seq_id = seq_id
+        self._q: "queue.Queue[Any]" = queue.Queue()
+        self._final: dict | None = None
+        self._ended = False  # sentinel consumed (iteration or next_event)
+
+    # engine side -----------------------------------------------------
+    def _emit(self, ev: dict) -> None:
+        self._q.put(ev)
+
+    def _close(self, final: dict) -> None:
+        self._final = final
+        self._q.put(_FINAL)
+
+    # consumer side ---------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._ended:
+            raise StopIteration
+        ev = self._q.get()
+        if ev is _FINAL:
+            self._ended = True
+            raise StopIteration
+        return ev
+
+    def next_event(self, timeout: float | None = None):
+        """Blocking fetch; returns None at end-of-stream (persistently —
+        mixing with iteration is safe) and raises TimeoutError if no
+        event arrives within `timeout` seconds."""
+        if self._ended:
+            return None
+        try:
+            ev = self._q.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(
+                f"no token event within {timeout}s") from None
+        if ev is _FINAL:
+            self._ended = True
+            return None
+        return ev
+
+    def final(self) -> dict | None:
+        return self._final
+
+
+class LLMEngine:
+    """Continuous-batching engine for one model instance."""
+
+    def __init__(self, config: EngineConfig, *, params: Any = None,
+                 mesh=None):
+        import jax
+
+        self.config = config
+        reg = adapters()
+        if config.model not in reg:
+            raise ValueError(
+                f"unknown model {config.model!r}; have {sorted(reg)}")
+        adapter = reg[config.model]
+        if config.model_config is not None:
+            cfg = config.model_config
+        else:
+            try:
+                cfg = adapter.presets[config.preset]()
+            except KeyError:
+                raise ValueError(
+                    f"unknown preset {config.preset!r} for "
+                    f"{config.model}; have {sorted(adapter.presets)}")
+        self.model_cfg = cfg
+        max_len = config.max_model_len or cfg.block_size
+        if max_len > cfg.block_size:
+            raise ValueError(
+                f"max_model_len {max_len} exceeds the model's positional "
+                f"range {cfg.block_size}")
+
+        if params is None:
+            params = adapter.init_fn(jax.random.PRNGKey(config.seed), cfg)
+
+        num_blocks = config.num_blocks
+        if num_blocks is None:
+            num_blocks = auto_num_blocks(
+                n_layer=cfg.n_layer,
+                n_kv_head=adapter.kv_heads(cfg),
+                head_dim=cfg.head_dim,
+                block_size=config.block_size,
+                dtype_bytes=jax.numpy.dtype(cfg.dtype).itemsize,
+                max_model_len=max_len,
+                max_batch_size=config.max_batch_size,
+                memory_fraction=config.memory_fraction,
+                tensor_ways=(dict(mesh.shape).get("tensor", 1)
+                             if mesh is not None else 1),
+            )
+        max_blocks_per_seq = (max_len + config.block_size - 1) \
+            // config.block_size
+        if num_blocks - 1 < max_blocks_per_seq:
+            raise ValueError(
+                f"pool of {num_blocks} blocks cannot hold one "
+                f"max_model_len={max_len} sequence "
+                f"({max_blocks_per_seq} blocks needed); raise num_blocks "
+                f"or lower max_model_len")
+
+        self.pool = BlockPool(num_blocks, config.block_size)
+        self.runner = ModelRunner(
+            adapter, cfg, params,
+            block_size=config.block_size,
+            num_blocks=num_blocks,
+            max_model_len=max_len,
+            max_batch_size=config.max_batch_size,
+            prefill_bucket_min=config.prefill_bucket_min,
+            mesh=mesh,
+            sample_seed=config.seed + 1,
+        )
+        self.scheduler = Scheduler(
+            self.pool, max_batch_size=config.max_batch_size,
+            max_model_len=max_len)
+
+        self._ids = itertools.count()
+        self._streams: dict[int, RequestStream] = {}  # guarded_by(_lock)
+        self._lock = threading.Lock()
+        self._step_lock = threading.Lock()
+        self._tokens_window: list[tuple[float, int]] = []  # (t, n)
+        self._build_metrics()
+
+    # ----------------------------------------------------------- metrics
+
+    def _build_metrics(self):
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        tags = ("model",)
+        self._m_tags = {"model": self.config.model}
+        self._m_tokens = Counter(
+            "serve_llm_tokens_generated_total",
+            "Tokens generated by this engine", tag_keys=tags)
+        self._m_requests = Counter(
+            "serve_llm_requests_total",
+            "Requests finished, by outcome",
+            tag_keys=("model", "outcome"))
+        self._m_preempt = Counter(
+            "serve_llm_preemptions_total",
+            "Sequences preempted on cache exhaustion", tag_keys=tags)
+        self._m_queue = Gauge(
+            "serve_llm_queue_depth", "Waiting requests", tag_keys=tags)
+        self._m_running = Gauge(
+            "serve_llm_running", "Sequences in the decode set",
+            tag_keys=tags)
+        self._m_cache = Gauge(
+            "serve_llm_cache_utilization",
+            "KV pool pages in use / usable pages", tag_keys=tags)
+        self._m_tps = Gauge(
+            "serve_llm_tokens_per_sec",
+            "Generation throughput over the last ~5s", tag_keys=tags)
+        self._m_ttft = Histogram(
+            "serve_llm_ttft_ms", "Time to first token",
+            boundaries=(1, 5, 10, 25, 50, 100, 250, 500, 1000, 5000),
+            tag_keys=tags)
+        self._m_step = Histogram(
+            "serve_llm_step_ms", "Engine step latency",
+            boundaries=(1, 5, 10, 25, 50, 100, 250, 500, 1000),
+            tag_keys=("model", "kind"))
+
+    def _note_tokens(self, n: int) -> None:
+        self._m_tokens.inc(n, tags=self._m_tags)
+        now = time.monotonic()
+        self._tokens_window.append((now, n))
+        cutoff = now - 5.0
+        while self._tokens_window and self._tokens_window[0][0] < cutoff:
+            self._tokens_window.pop(0)
+        span = max(1e-3, now - self._tokens_window[0][0]) \
+            if self._tokens_window else 1.0
+        self._m_tps.set(
+            sum(k for _, k in self._tokens_window) / span,
+            tags=self._m_tags)
+
+    # ------------------------------------------------------------ intake
+
+    def add_request(self, prompt: Seq[int],
+                    sampling: SamplingParams | None = None
+                    ) -> RequestStream:
+        sampling = sampling or SamplingParams()
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        seq = Sequence(seq_id=next(self._ids), prompt=prompt,
+                       sampling=sampling)
+        stream = RequestStream(seq.seq_id)
+        with self._lock:
+            # validate (scheduler.add raises on over-long prompts) BEFORE
+            # registering the stream, or rejected requests leak entries
+            self.scheduler.add(seq)
+            self._streams[seq.seq_id] = stream
+        self._m_queue.set(len(self.scheduler.waiting), tags=self._m_tags)
+        return stream
+
+    def generate(self, prompt: Seq[int],
+                 sampling: SamplingParams | None = None,
+                 *, drive: bool = False, timeout: float = 120.0) -> dict:
+        """Blocking convenience: returns the final event. With
+        ``drive=True`` the caller's thread steps the engine itself
+        (tests, bench — no loop thread needed)."""
+        stream = self.add_request(prompt, sampling)
+        deadline = time.monotonic() + timeout
+        if drive:
+            while stream.final() is None:
+                if not self.step():
+                    time.sleep(0.001)
+                if time.monotonic() > deadline:
+                    raise TimeoutError("generate() timed out")
+            for _ in stream:
+                pass
+            return stream.final()
+        while True:
+            ev = stream.next_event(
+                timeout=max(0.01, deadline - time.monotonic()))
+            if ev is None:  # end of stream
+                return stream.final()
+            if time.monotonic() > deadline:
+                raise TimeoutError("generate() timed out")
+
+    # -------------------------------------------------------------- step
+
+    def step(self) -> bool:
+        """One scheduler decision + one device program. Returns False
+        when there was nothing to do. Serialized: concurrent callers
+        queue behind `_step_lock` (the deployment runs a single loop
+        thread; tests may drive from several)."""
+        with self._step_lock:
+            with self._lock:
+                pre = self.scheduler.preemption_count
+                work = self.scheduler.schedule()  # may preempt lanes
+                d_pre = self.scheduler.preemption_count - pre
+                retired = self.scheduler.take_retired()
+            if d_pre:
+                self._m_preempt.inc(d_pre, tags=self._m_tags)
+            for s in retired:  # schedule() closed these out itself
+                self._finalize(s)
+            if work is None:
+                return retired != []
+            t0 = time.perf_counter()
+            if isinstance(work, PrefillWork):
+                self._do_prefill(work.seq)
+                kind = "prefill"
+            else:
+                self._do_decode(work)
+                kind = "decode"
+            self._m_step.observe(
+                (time.perf_counter() - t0) * 1e3,
+                tags={"model": self.config.model, "kind": kind})
+            depth = self.scheduler.depth()
+            self._m_queue.set(depth["waiting"], tags=self._m_tags)
+            self._m_running.set(depth["running"], tags=self._m_tags)
+            self._m_cache.set(depth["cache_utilization"],
+                              tags=self._m_tags)
+            return True
+
+    def _do_prefill(self, seq: Sequence) -> None:
+        tokens = seq.refill_tokens
+        try:
+            nxt, _ = self.runner.prefill(
+                tokens, seq.table, seq.sampling.temperature)
+        except Exception as e:  # noqa: BLE001
+            with self._lock:
+                self.scheduler.abort(seq, f"error:{e!r}")
+            self._finalize(seq)
+            return
+        if seq.first_token_at is None:
+            self._m_ttft.observe(
+                (time.monotonic() - seq.enqueued_at) * 1e3,
+                tags=self._m_tags)
+        with self._lock:
+            done = self.scheduler.commit_token(seq, nxt)
+        self._emit_token(seq, nxt)
+        self._note_tokens(1)
+        if done:
+            self._finalize(seq)
+
+    def _do_decode(self, work: DecodeWork) -> None:
+        # the lane feeds generated[-1], which LIVES at absolute position
+        # pos-1 (it was sampled but never cached): rope/wpe index, the
+        # context mask, and the KV scatter all key off that position
+        items = [DecodeItem(s.last_token, s.pos - 1, s.table,
+                            s.sampling.temperature) for s in work.seqs]
+        try:
+            next_tokens, _ = self.runner.decode(items)
+        except Exception as e:  # noqa: BLE001
+            with self._lock:
+                for s in work.seqs:
+                    self.scheduler.abort(s, f"error:{e!r}")
+            for s in work.seqs:
+                self._finalize(s)
+            return
+        finished = []
+        with self._lock:
+            for s, tok in zip(work.seqs, next_tokens):
+                if self.scheduler.commit_token(s, tok):
+                    finished.append(s)
+        for s, tok in zip(work.seqs, next_tokens):
+            self._emit_token(s, tok)
+        self._note_tokens(len(next_tokens))
+        for s in finished:
+            self._finalize(s)
+
+    # ------------------------------------------------------------ output
+
+    def _emit_token(self, seq: Sequence, token: int) -> None:
+        with self._lock:
+            stream = self._streams.get(seq.seq_id)
+        if stream is not None:
+            stream._emit({"token": int(token),
+                          "index": len(seq.generated) - 1})
+
+    def _finalize(self, seq: Sequence) -> None:
+        with self._lock:
+            stream = self._streams.pop(seq.seq_id, None)
+        if stream is None:
+            return  # already finalized (idempotent: no double-count)
+        outcome = (seq.finish_reason or "unknown").split(":", 1)[0]
+        self._m_requests.inc(
+            tags={"model": self.config.model, "outcome": outcome})
+        final = {
+            "done": True,
+            "finish_reason": seq.finish_reason,
+            "num_generated": len(seq.generated),
+            "token_ids": list(seq.generated),
+            "preemptions": seq.preemptions,
+        }
+        if seq.sampling.echo:
+            final["prompt_token_ids"] = list(seq.prompt)
+        stream._close(final)
+
+    # ------------------------------------------------------------- admin
+
+    def warmup(self) -> int:
+        """Precompile every bucketed program (prefill lengths x decode
+        batch sizes) so no request pays a mid-stream XLA compile;
+        returns the compiled-program count."""
+        with self._step_lock:
+            return self.runner.warmup()
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(self.scheduler.waiting or self.scheduler.running)
+
+    def stats(self) -> dict:
+        d = self.scheduler.depth()
+        d.update({
+            "model": self.config.model,
+            "block_size": self.pool.block_size,
+            "max_batch_size": self.config.max_batch_size,
+            "max_model_len": self.runner.max_model_len,
+            "compiled_programs": self.runner.compiled_signatures(),
+        })
+        return d
+
+    def abort_request(self, stream: RequestStream,
+                      reason: str = "aborted") -> None:
+        with self._lock:
+            seqs = [s for s in
+                    list(self.scheduler.waiting) + self.scheduler.running
+                    if s.seq_id == stream.seq_id]
+        for s in seqs:
+            with self._lock:
+                self.scheduler.abort(s, reason)
+            self._finalize(s)

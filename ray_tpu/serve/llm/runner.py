@@ -1,0 +1,326 @@
+"""ModelRunner: jit-compiled paged prefill / decode steps.
+
+Owns the device-side half of the KV cache (one K and one V array of
+shape ``(L, num_blocks, block_size, H_kv, D)``) and the two compiled
+programs that touch it:
+
+- **prefill**: full-sequence forward of one prompt (padded to a length
+  bucket), scattering every position's K/V into its page and sampling
+  the first generated token from the last valid position's logits;
+- **decode**: one token for a batch of sequences (padded to a batch
+  bucket), gathering each lane's pages through its block table,
+  attending with a validity mask, scattering the new K/V at the lane's
+  current position, and sampling the next token.
+
+Shapes are **bucketed** so the number of XLA compilations is bounded:
+prompt lengths round up to powers of two between
+``prefill_bucket_min`` and ``max_model_len``; decode batches round up
+to powers of two up to ``max_batch_size``; block tables are always
+padded to the fixed width ``max_blocks_per_seq``. Total programs =
+#length-buckets + #batch-buckets.
+
+Padded lanes/positions point at **page 0** (the pool's null sink), so
+every gather/scatter is in-bounds; the attention mask keeps null-page
+garbage out of the softmax.
+
+With a mesh, parameters are sharded via the model's own
+`parallel/sharding.py` partition rules and the cache pages are sharded
+over the ``tensor`` axis on the KV-head dimension; calls run under
+``with mesh:`` so in-model `constrain` calls resolve (same idiom as
+train/spmd.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+from typing import Any, Callable, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelAdapter:
+    """Uniform view over a model family for the engine/runner."""
+
+    name: str
+    config_cls: type
+    presets: dict[str, Callable[[], Any]]
+    init_fn: Callable  # (key, cfg) -> params
+    prefill_fn: Callable  # (params, tokens, cfg) -> (logits, k, v)
+    decode_fn: Callable  # (params, toks, pos, kc, vc, mask, cfg) -> ...
+    rules_fn: Callable  # () -> PartitionRules
+    kv_heads: Callable[[Any], int]
+
+
+def adapters() -> dict[str, ModelAdapter]:
+    """Model registry (lazy imports keep `import ray_tpu.serve` light)."""
+    from ray_tpu.models import gpt2, llama
+
+    return {
+        "gpt2": ModelAdapter(
+            name="gpt2",
+            config_cls=gpt2.GPT2Config,
+            presets={
+                "tiny": gpt2.GPT2Config.tiny,
+                "small": gpt2.GPT2Config.small,
+                "medium": gpt2.GPT2Config.medium,
+                "large": gpt2.GPT2Config.large,
+                "xl": gpt2.GPT2Config.xl,
+            },
+            init_fn=gpt2.init_gpt2,
+            prefill_fn=gpt2.gpt2_prefill_kv,
+            decode_fn=gpt2.gpt2_decode_kv,
+            rules_fn=gpt2.gpt2_partition_rules,
+            kv_heads=lambda cfg: cfg.n_head,
+        ),
+        "llama": ModelAdapter(
+            name="llama",
+            config_cls=llama.LlamaConfig,
+            presets={
+                "tiny": llama.LlamaConfig.tiny,
+                "small": llama.LlamaConfig.small,
+            },
+            init_fn=llama.init_llama,
+            prefill_fn=llama.llama_prefill_kv,
+            decode_fn=llama.llama_decode_kv,
+            rules_fn=llama.llama_partition_rules,
+            kv_heads=lambda cfg: cfg.n_kv_head,
+        ),
+    }
+
+
+class DecodeItem(NamedTuple):
+    token: int  # last sampled token (input to this step)
+    pos: int  # its absolute position (== tokens written so far)
+    table: Sequence[int]  # physical page ids, logical order
+    temperature: float
+
+
+def _next_pow2(n: int, lo: int) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class ModelRunner:
+    """Executes prefill/decode for one model instance. Not thread-safe:
+    exactly one step-loop thread drives it (the engine enforces this);
+    construction may happen on a different thread than stepping."""
+
+    def __init__(
+        self,
+        adapter: ModelAdapter,
+        cfg: Any,
+        params: Any,
+        *,
+        block_size: int,
+        num_blocks: int,
+        max_model_len: int,
+        max_batch_size: int,
+        prefill_bucket_min: int = 16,
+        mesh=None,
+        sample_seed: int = 0,
+    ):
+        self.adapter = adapter
+        self.cfg = cfg
+        self.mesh = mesh
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.max_model_len = max_model_len
+        self.max_batch_size = max_batch_size
+        self.prefill_bucket_min = prefill_bucket_min
+        self.max_blocks_per_seq = (
+            max_model_len + block_size - 1) // block_size
+
+        hk = adapter.kv_heads(cfg)
+        hd = cfg.head_dim
+        L = cfg.n_layer
+        page_shape = (L, num_blocks, block_size, hk, hd)
+
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ray_tpu.parallel.sharding import (
+                _prune_spec, shard_pytree)
+
+            self.params = shard_pytree(params, adapter.rules_fn(), mesh)
+            tensor_ways = dict(mesh.shape).get("tensor", 1)
+            if tensor_ways > 1 and hk % tensor_ways == 0:
+                kv_spec = _prune_spec(
+                    P(None, None, None, "tensor", None), mesh)
+            else:
+                kv_spec = P()  # uneven KV heads: replicate the pages
+            sharding = NamedSharding(mesh, kv_spec)
+            self.k_pages = jax.device_put(
+                jnp.zeros(page_shape, cfg.dtype), sharding)
+            self.v_pages = jax.device_put(
+                jnp.zeros(page_shape, cfg.dtype), sharding)
+        else:
+            self.params = params
+            self.k_pages = jnp.zeros(page_shape, cfg.dtype)
+            self.v_pages = jnp.zeros(page_shape, cfg.dtype)
+
+        self._base_key = jax.random.PRNGKey(sample_seed)
+        self._step_counter = 0
+        # donation elides the pages copy per step; CPU jax would only
+        # warn "donation is not implemented", so gate on backend
+        donate = (1, 2) if jax.default_backend() in ("tpu", "axon") else ()
+        self._prefill_jit = jax.jit(self._prefill_impl, donate_argnums=donate)
+        self._decode_jit = jax.jit(self._decode_impl, donate_argnums=donate)
+        # pages are mutated functionally; serialize compute just in case
+        # a stats probe races the step loop
+        self._jit_lock = threading.Lock()
+
+    # ------------------------------------------------------------- traced
+
+    def _sample(self, logits, temps, step):
+        """Greedy when temp==0, else temperature sampling; vocab padding
+        is always masked out."""
+        V = logits.shape[-1]
+        mask = jnp.arange(V) < self.cfg.vocab_size
+        logits = jnp.where(mask, logits, -1e30)
+        greedy = jnp.argmax(logits, axis=-1)
+        key = jax.random.fold_in(self._base_key, step)
+        safe = jnp.where(temps > 0, temps, 1.0)[:, None]
+        sampled = jax.random.categorical(key, logits / safe, axis=-1)
+        return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+
+    def _prefill_impl(self, params, k_pages, v_pages, tokens, last_idx,
+                      block_ids, offsets, temp, step):
+        """tokens (1, Tb); block_ids/offsets (Tb,) map position t to its
+        page slot (padded positions -> null page 0)."""
+        logits, k, v = self.adapter.prefill_fn(params, tokens, self.cfg)
+        # (L, 1, Tb, HK, D) -> (L, Tb, HK, D)
+        k_pages = k_pages.at[:, block_ids, offsets].set(k[:, 0])
+        v_pages = v_pages.at[:, block_ids, offsets].set(v[:, 0])
+        last = jnp.take(logits[0], last_idx, axis=0)  # (Vp,)
+        nxt = self._sample(last[None, :], temp, step)[0]
+        return nxt, last, k_pages, v_pages
+
+    def _decode_impl(self, params, k_pages, v_pages, tokens, positions,
+                     tables, temps, step):
+        """tokens/positions/temps (Sb,); tables (Sb, max_blocks_per_seq).
+        Gather pages -> dense context, run the model's decode step,
+        scatter the new K/V at each lane's position, sample."""
+        L = self.cfg.n_layer
+        S = tokens.shape[0]
+        Bs = self.block_size
+        C = self.max_blocks_per_seq * Bs
+        k_ctx = k_pages[:, tables]  # (L, S, MaxB, Bs, HK, D)
+        k_ctx = k_ctx.reshape(L, S, C, *k_ctx.shape[4:])
+        v_ctx = v_pages[:, tables]
+        v_ctx = v_ctx.reshape(L, S, C, *v_ctx.shape[4:])
+        ctx_mask = jnp.arange(C)[None, :] < positions[:, None]
+        logits, k_new, v_new = self.adapter.decode_fn(
+            params, tokens, positions, k_ctx, v_ctx, ctx_mask, self.cfg)
+        block_ids = jnp.take_along_axis(
+            tables, (positions // Bs)[:, None], axis=1)[:, 0]
+        offsets = positions % Bs
+        k_pages = k_pages.at[:, block_ids, offsets].set(k_new)
+        v_pages = v_pages.at[:, block_ids, offsets].set(v_new)
+        nxt = self._sample(logits, temps, step)
+        return nxt, logits, k_pages, v_pages
+
+    # -------------------------------------------------------------- host
+
+    def _mesh_ctx(self):
+        return self.mesh if self.mesh is not None else contextlib.nullcontext()
+
+    def prefill_bucket(self, n: int) -> int:
+        if n > self.max_model_len:
+            raise ValueError(
+                f"prompt of {n} tokens exceeds max_model_len "
+                f"{self.max_model_len}")
+        return min(_next_pow2(n, self.prefill_bucket_min),
+                   self.max_model_len)
+
+    def decode_bucket(self, n: int) -> int:
+        return min(_next_pow2(n, 1), self.max_batch_size)
+
+    def prefill(self, token_ids: Sequence[int], table: Sequence[int],
+                temperature: float) -> tuple[int, np.ndarray]:
+        """Run one prompt through prefill; returns (first generated
+        token, last-position logits). `table` must cover
+        blocks_for_tokens(len(token_ids)) pages."""
+        n = len(token_ids)
+        Tb = self.prefill_bucket(n)
+        toks = np.zeros((1, Tb), np.int32)
+        toks[0, :n] = token_ids
+        block_ids = np.zeros((Tb,), np.int32)
+        offsets = np.arange(Tb, dtype=np.int32) % self.block_size
+        pos = np.arange(n)
+        block_ids[:n] = np.asarray(table, np.int32)[pos // self.block_size]
+        temp = np.asarray([temperature], np.float32)
+        self._step_counter += 1
+        with self._mesh_ctx(), self._jit_lock:
+            nxt, last, self.k_pages, self.v_pages = self._prefill_jit(
+                self.params, self.k_pages, self.v_pages, toks,
+                np.int32(n - 1), block_ids, offsets, temp,
+                np.int32(self._step_counter))
+        return int(nxt), np.asarray(last)
+
+    def decode(self, items: Sequence[DecodeItem]
+               ) -> tuple[list[int], np.ndarray]:
+        """One decode step for up to max_batch_size sequences; returns
+        (next token per item, logits (len(items), Vp))."""
+        S = len(items)
+        if not 0 < S <= self.max_batch_size:
+            raise ValueError(f"decode batch of {S}")
+        Sb = self.decode_bucket(S)
+        toks = np.zeros((Sb,), np.int32)
+        poss = np.zeros((Sb,), np.int32)
+        tables = np.zeros((Sb, self.max_blocks_per_seq), np.int32)
+        temps = np.zeros((Sb,), np.float32)
+        for i, it in enumerate(items):
+            toks[i] = it.token
+            poss[i] = it.pos
+            tables[i, :len(it.table)] = it.table
+            temps[i] = it.temperature
+        self._step_counter += 1
+        with self._mesh_ctx(), self._jit_lock:
+            nxt, logits, self.k_pages, self.v_pages = self._decode_jit(
+                self.params, self.k_pages, self.v_pages, toks, poss,
+                tables, temps, np.int32(self._step_counter))
+        nxt = np.asarray(nxt)
+        return [int(t) for t in nxt[:S]], np.asarray(logits)[:S]
+
+    def warmup(self) -> int:
+        """Compile every (bucket, kind) program up front so no request
+        ever pays a mid-stream XLA compile (the TPU serving idiom:
+        static shapes, all compiled at startup). All writes/reads target
+        the null page, so the warm cache state is untouched as far as
+        any real sequence is concerned. Returns #programs compiled."""
+        null_table = [0] * self.max_blocks_per_seq
+        b = min(self.prefill_bucket_min, self.max_model_len)
+        while True:
+            self.prefill([1] * b, null_table, 0.0)
+            if b >= self.max_model_len:
+                break
+            b = min(b * 2, self.max_model_len)
+        s = 1
+        while True:
+            self.decode([DecodeItem(1, 0, null_table, 0.0)] * s)
+            if s >= self.max_batch_size:
+                break
+            s = min(s * 2, self.max_batch_size)
+        return self.compiled_signatures()
+
+    def reset_cache(self) -> None:
+        """Zero the pages (tests); allocator state lives in BlockPool."""
+        self.k_pages = jnp.zeros_like(self.k_pages)
+        self.v_pages = jnp.zeros_like(self.v_pages)
+
+    def compiled_signatures(self) -> int:
+        """Number of distinct compiled programs so far — the
+        recompilation-boundedness observable used by tests/metrics.
+        Bounded by #length-buckets + #batch-buckets by construction."""
+        try:
+            return (self._prefill_jit._cache_size()
+                    + self._decode_jit._cache_size())
+        except Exception:  # noqa: BLE001
+            return -1

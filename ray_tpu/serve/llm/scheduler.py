@@ -1,0 +1,233 @@
+"""Continuous-batching scheduler (reference shape: vLLM's scheduler,
+reduced to the TPU-static-shape essentials).
+
+State machine per sequence::
+
+    WAITING --admit(prefill)--> RUNNING --eos/max-tokens--> FINISHED
+       ^                          |
+       +------- preempt ----------+   (cache pool exhausted)
+
+Policy, chosen per step by `schedule()`:
+
+- **prefill-first**: if a waiting sequence fits (a free decode lane AND
+  enough free pages for its prompt), admit it — keeping lanes full
+  maximizes decode batch size, which is where TPU throughput lives;
+- otherwise **decode** every running sequence in one batched step;
+- before a decode step, any lane crossing a page boundary gets one new
+  page; if the pool is dry, the **most recently admitted** lane is
+  preempted (recompute-style: its pages are freed and it re-enters the
+  waiting queue FRONT with prompt+generated as its new prompt — with
+  greedy sampling its continuation is bit-identical, which the tests
+  assert). LIFO victim choice protects the oldest sequences' progress.
+
+The scheduler owns no locks: the engine serializes calls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from collections import deque
+
+from ray_tpu.serve.llm.cache import BlockPool, CacheExhausted
+from ray_tpu.serve.llm.config import SamplingParams
+
+
+class SeqState(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    FINISHED = "finished"
+
+
+@dataclasses.dataclass
+class Sequence:
+    """One request's scheduling view."""
+
+    seq_id: int
+    prompt: list[int]
+    sampling: SamplingParams
+    state: SeqState = SeqState.WAITING
+    generated: list[int] = dataclasses.field(default_factory=list)
+    table: list[int] = dataclasses.field(default_factory=list)
+    last_token: int = -1  # input to the next decode step
+    preemptions: int = 0
+    enqueued_at: float = dataclasses.field(default_factory=time.monotonic)
+    first_token_at: float | None = None
+    finish_reason: str | None = None
+
+    @property
+    def refill_tokens(self) -> list[int]:
+        """What prefill must run over: the original prompt plus anything
+        generated before a preemption (recompute-style resume)."""
+        return self.prompt + self.generated
+
+    @property
+    def pos(self) -> int:
+        """prompt+generated length. The cache holds positions
+        0..pos-2 (the last generated token is sampled but not yet
+        cached); the next decode step feeds it at position pos-1 and
+        writes its KV there."""
+        return len(self.prompt) + len(self.generated)
+
+    def eos_hit(self, token: int) -> bool:
+        return token in self.sampling.eos_set()
+
+
+@dataclasses.dataclass
+class PrefillWork:
+    seq: Sequence
+
+
+@dataclasses.dataclass
+class DecodeWork:
+    seqs: list[Sequence]
+
+
+class Scheduler:
+    def __init__(self, pool: BlockPool, *, max_batch_size: int,
+                 max_model_len: int):
+        self.pool = pool
+        self.max_batch_size = max_batch_size
+        self.max_model_len = max_model_len
+        self.waiting: deque[Sequence] = deque()
+        self.running: list[Sequence] = []  # admission order (LIFO victim)
+        self.preemption_count = 0
+        # sequences retired INSIDE schedule() (length cap backstop,
+        # cache_exhausted fail-loud) — the engine drains these every
+        # step so their streams still get closed
+        self.retired_in_schedule: list[Sequence] = []
+
+    # ------------------------------------------------------------ intake
+
+    def add(self, seq: Sequence) -> None:
+        if len(seq.prompt) >= self.max_model_len:
+            raise ValueError(
+                f"prompt of {len(seq.prompt)} tokens needs at least one "
+                f"free position below max_model_len={self.max_model_len}")
+        self.waiting.append(seq)
+
+    def abort(self, seq: Sequence, reason: str = "aborted") -> None:
+        if seq.state is SeqState.RUNNING:
+            self.running.remove(seq)
+        elif seq.state is SeqState.WAITING:
+            try:
+                self.waiting.remove(seq)
+            except ValueError:
+                pass
+        self._finish(seq, reason)
+
+    # ---------------------------------------------------------- planning
+
+    def schedule(self) -> PrefillWork | DecodeWork | None:
+        """Pick the next unit of work. Admission never preempts: a
+        waiting sequence only enters when pages are genuinely free."""
+        if self.waiting and len(self.running) < self.max_batch_size:
+            seq = self.waiting[0]
+            need = self.pool.blocks_for_tokens(len(seq.refill_tokens))
+            if self.pool.can_alloc(need):
+                self.waiting.popleft()
+                seq.table = self.pool.alloc(need)
+                seq.state = SeqState.RUNNING
+                self.running.append(seq)
+                return PrefillWork(seq)
+        if not self.running:
+            return None
+        self._grow_tables_or_preempt()
+        if not self.running:
+            return None
+        return DecodeWork(list(self.running))
+
+    def _grow_tables_or_preempt(self) -> None:
+        """Every running lane must own the page its next token writes
+        into; preempt (LIFO) until the survivors all fit."""
+        i = 0
+        while i < len(self.running):
+            seq = self.running[i]
+            if seq.pos > self.max_model_len:
+                # next decode would write at position pos-1 >= cap:
+                # close out at the length limit
+                self._retire(seq, "length")
+                self.retired_in_schedule.append(seq)
+                continue
+            # the decode step writes KV at position pos-1, so the table
+            # must cover pos tokens
+            needed = self.pool.blocks_for_tokens(seq.pos)
+            if len(seq.table) >= needed:
+                i += 1
+                continue
+            try:
+                seq.table.extend(self.pool.alloc(needed - len(seq.table)))
+                i += 1
+            except CacheExhausted:
+                victim = self.running[-1]
+                if victim is seq and len(self.running) == 1:
+                    # sole runner and the pool can't grow it: engine
+                    # guarantees pool >= one max-len sequence, so this
+                    # is unreachable unless misconfigured — fail loud
+                    self._retire(seq, "error:cache_exhausted")
+                    self.retired_in_schedule.append(seq)
+                    return
+                self.preempt(victim)
+                if victim is seq:
+                    continue  # re-examine slot i (new occupant)
+
+    def preempt(self, seq: Sequence) -> None:
+        """Recompute-style: free pages, requeue at the FRONT so the
+        victim re-admits as soon as space frees up."""
+        self.running.remove(seq)
+        self.pool.free(seq.table)
+        seq.table = []
+        seq.state = SeqState.WAITING
+        seq.preemptions += 1
+        self.preemption_count += 1
+        self.waiting.appendleft(seq)
+
+    # ----------------------------------------------------------- results
+
+    def commit_token(self, seq: Sequence, token: int) -> bool:
+        """Record one generated token; returns True if the sequence is
+        now finished."""
+        seq.generated.append(token)
+        seq.last_token = token
+        if seq.first_token_at is None:
+            seq.first_token_at = time.monotonic()
+        if seq.eos_hit(token):
+            self._retire(seq, "eos")
+            return True
+        if len(seq.generated) >= seq.sampling.max_tokens:
+            self._retire(seq, "length")
+            return True
+        if seq.pos >= self.max_model_len:
+            self._retire(seq, "length")
+            return True
+        return False
+
+    def _retire(self, seq: Sequence, reason: str) -> None:
+        if seq in self.running:
+            self.running.remove(seq)
+        self._finish(seq, reason)
+
+    def _finish(self, seq: Sequence, reason: str) -> None:
+        self.pool.free(seq.table)
+        seq.table = []
+        seq.state = SeqState.FINISHED
+        seq.finish_reason = reason
+
+    def take_retired(self) -> list[Sequence]:
+        """Drain sequences retired inside schedule(); caller (the
+        engine) closes their streams."""
+        out, self.retired_in_schedule = self.retired_in_schedule, []
+        return out
+
+    # ------------------------------------------------------------- stats
+
+    def depth(self) -> dict:
+        return {
+            "waiting": len(self.waiting),
+            "running": len(self.running),
+            "blocks_used": self.pool.num_used(),
+            "blocks_total": self.pool.usable_blocks,
+            "cache_utilization": self.pool.utilization(),
+            "preemptions": self.preemption_count,
+        }

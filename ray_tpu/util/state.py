@@ -224,3 +224,23 @@ def serve_status(address: str | None = None) -> dict:
                     f"{address!r}; serve status reflects the connected "
                     "cluster")
     return serve.status()
+
+
+def llm_status(app_name: str) -> list[dict]:
+    """Per-replica LLM engine stats for a `serve.llm` app: queue depth,
+    running lanes, cache utilization, preemptions, compiled-program
+    count. One dict per replica (the handle routes to a single replica;
+    this asks the controller for the full set). Probes ride the
+    replicas' control concurrency group, so they answer even while
+    every request lane is mid-stream."""
+    import ray_tpu
+    from ray_tpu.serve.api import _CONTROLLER_NAME
+
+    ctrl = ray_tpu.get_actor(_CONTROLLER_NAME)
+    r = ray_tpu.get(ctrl.get_replicas.remote(app_name), timeout=30)
+    if not r["replicas"]:
+        raise ValueError(f"no serve application named {app_name!r}")
+    refs = [rep.handle_request.options(
+        concurrency_group="control").remote("engine_stats", (), {})
+        for rep in r["replicas"]]
+    return ray_tpu.get(refs, timeout=30)

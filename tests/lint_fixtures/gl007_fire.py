@@ -1,0 +1,21 @@
+"""GL007 firing fixture: store.get() pins with no release()."""
+
+
+class Nodelet:
+    def __init__(self, store):
+        self.store = store
+
+    def read_once(self, oid):
+        buf = self.store.get(oid)  # FIRE: no release in this function
+        return bytes(buf)
+
+    def checksum(self, oid):
+        view = self.store.get(oid)  # FIRE: released on the WRONG store
+        other_store = object()
+        other_store.release(oid)
+        return sum(view)
+
+
+def copy_out(store, oid, dst):
+    view = store.get(oid)  # FIRE: module-level helper, never releases
+    dst[:] = view

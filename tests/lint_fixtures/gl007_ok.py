@@ -1,0 +1,33 @@
+"""GL007 non-firing fixture: every pin is released or handed off."""
+
+
+class Nodelet:
+    def __init__(self, store):
+        self.store = store
+        self.meta = {}
+
+    def read(self, oid):
+        buf = self.store.get(oid)
+        try:
+            return bytes(buf)
+        finally:
+            self.store.release(oid)
+
+    def open_view(self, oid):
+        """Zero-copy hand-off; caller releases via store.release(oid)."""
+        return self.store.get(oid)
+
+    def borrow_unreleased(self, oid):
+        return self.store.get(oid)  # *_unreleased suffix: hand-off
+
+    def config(self, r):
+        store = r.get("store", {})  # a dict named store: not a pin
+        return store.get("capacity", 0)
+
+    def nested_release(self, oid):
+        view = self.store.get(oid)
+
+        def done():
+            self.store.release(oid)
+
+        return view, done

@@ -229,6 +229,7 @@ def _bowl(config):
                  (config["y"] - 0.7) ** 2})
 
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_tpe_beats_random_on_bowl(cluster, tmp_path):
     space = {"x": tune.uniform(0.0, 1.0), "y": tune.uniform(0.0, 1.0)}
     n = 30
@@ -300,6 +301,7 @@ def _run_population(scheduler, name, tmp_path, seed):
                if "score" in r.metrics)
 
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_pb2_beats_pbt_on_noisy_hill(cluster, tmp_path):
     # {"x": None} selects PBT's numeric path: current value * 0.8/1.2
     pbt = tune.PopulationBasedTraining(

@@ -45,6 +45,7 @@ def _build(offline_pendulum, **kw):
     return cfg.build()
 
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_cql_conservative_property(offline_pendulum):
     """After training, Q(dataset actions) > Q(random OOD actions): the
     penalty explicitly minimizes logsumexp_a Q - Q(a_data)."""
@@ -56,6 +57,7 @@ def test_cql_conservative_property(offline_pendulum):
     assert gap > 0.0, f"dataset-action Q advantage {gap} not positive"
 
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_cql_alpha_zero_is_plain_sac_critic(offline_pendulum):
     """With cql_alpha=0 the conservative pressure is gone — the OOD gap
     stays near zero (sanity that the knob drives the property)."""

@@ -118,6 +118,7 @@ def test_compiled_dag_multi_output(ray_boot):
 
 # ---------------------------------------------------------------- offline RL
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_offline_record_bc_marwil(ray_boot, tmp_path):
     """Record expert experiences -> parquet -> BC clones the policy to
     eval-solve CartPole; MARWIL's advantage weighting also learns."""
@@ -164,6 +165,7 @@ def test_offline_record_bc_marwil(ray_boot, tmp_path):
 
 # ---------------------------------------------------------------- multi-agent
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_multi_agent_shared_policy_learns():
     import jax
 
@@ -179,6 +181,7 @@ def test_multi_agent_shared_policy_learns():
     assert last > 20  # near-perfect (max 25)
 
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_multi_agent_independent_policies():
     import jax
 

@@ -22,6 +22,7 @@ def ray_cluster():
     ray_tpu.shutdown()
 
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_random_shuffle_preserves_multiset(ray_cluster):
     ds = rd.range(1000, parallelism=8)
     out = ds.random_shuffle(seed=7).take_all()
@@ -35,6 +36,7 @@ def test_random_shuffle_deterministic_with_seed(ray_cluster):
     assert a == b
 
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_sort_scalars_multi_block(ray_cluster):
     rng = np.random.RandomState(0)
     vals = [int(v) for v in rng.randint(0, 10_000, 2_000)]

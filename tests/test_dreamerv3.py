@@ -128,6 +128,7 @@ def test_checkpoint_roundtrip(algo, tmp_path):
     algo2.stop()
 
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_image_observations_conv_world_model():
     """DreamerV3 on a pixel env: the conv encoder + pixel decoder world
     model fits (reference: DreamerV3's headline domain is pixels)."""

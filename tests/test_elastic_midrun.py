@@ -18,6 +18,7 @@ from ray_tpu.cluster_utils import Cluster
 cloudpickle.register_pickle_by_value(sys.modules[__name__])
 
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_midrun_elastic_grows_gang(tmp_path):
     """A gang running at capacity 1 GROWS to 2 when a node joins mid-run
     (continuous scaling decision, not just start-time sizing)."""

@@ -35,6 +35,7 @@ RULE_CASES = [
     ("GL004", "host-transfer", "gl004_fire.py", "gl004_ok.py", 3),
     ("GL005", "guarded-by", "gl005_fire.py", "gl005_ok.py", 3),
     ("GL006", "except-hygiene", "gl006_fire.py", "gl006_ok.py", 3),
+    ("GL007", "unreleased-store-ref", "gl007_fire.py", "gl007_ok.py", 3),
 ]
 
 
@@ -55,7 +56,7 @@ def test_rule_fires_and_stays_quiet(code, name, fire, ok, n_expected):
 def test_rule_catalog_complete():
     catalog = rule_catalog()
     assert [c.code for c in catalog] == [
-        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006"]
+        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007"]
     for cls in catalog:
         assert cls.name and cls.description and cls.invariant
 

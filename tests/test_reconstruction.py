@@ -93,6 +93,7 @@ def _kill_volatile_and_recover(c, handle):
     c.wait_for_nodes()
 
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_nested_lost_chain_reconstructed(two_node_cluster):
     """A → B → C all on the dying node: getting C forces C's
     re-execution, whose lost ARG (B) is reconstructed owner-side when
@@ -122,6 +123,7 @@ def test_nested_lost_chain_reconstructed(two_node_cluster):
     assert int(arr[0]) == 2 and int(arr[-1]) == 300_001
 
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_reconstruction_racing_concurrent_borrowers(two_node_cluster):
     """Two consumers hit the same lost object concurrently: exactly one
     reconstruction runs (event-guarded) and both complete."""
@@ -147,6 +149,7 @@ def test_reconstruction_racing_concurrent_borrowers(two_node_cluster):
     assert sorted(outs) == [300_001, 300_002]
 
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_reconstruction_under_rpc_chaos(two_node_cluster):
     """Reconstruction still converges when the resubmission RPCs drop
     their first attempts (deterministic chaos budgets, ref
@@ -176,6 +179,7 @@ def test_reconstruction_under_rpc_chaos(two_node_cluster):
         rpc_mod.set_chaos("")
 
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_actor_results_not_reconstructable(two_node_cluster):
     """Actor task outputs carry no lineage (reference: actor task
     results are not rebuilt by the recovery manager) — a lost one
@@ -218,6 +222,7 @@ def test_retry_budget_exhaustion_raises(two_node_cluster):
         ray_tpu.get(ref, timeout=60)
 
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_nested_chain_with_consumer_on_stable_node(two_node_cluster):
     """The dead node held ONLY the intermediates; a stable-node consumer
     task transparently waits out the owner-driven reconstruction of its

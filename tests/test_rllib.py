@@ -68,6 +68,7 @@ def test_ppo_learns_cartpole_inline():
     assert best >= 195, f"PPO failed to learn CartPole (best {best})"
 
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_ppo_pipelined_learns_cartpole():
     """pipeline_sampling=True (async-learner overlap, one-update-stale
     batches): still learns CartPole — the clipped ratio absorbs the
@@ -201,6 +202,7 @@ def test_impala_learns_cartpole_async_thread():
     assert r["learner_updates"] > 50  # the background thread really ran
 
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_impala_distributed_async(cluster):
     """Remote env runners sampled asynchronously (no per-iteration
     barrier) — learning still happens end-to-end through the runtime."""

@@ -181,6 +181,7 @@ def test_metrics_logger_windows_and_lifetime():
 
 # ---------------------------------------------------------------- pixel PPO
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_ppo_pixel_env_with_learner_mesh():
     """PPO with the conv catalog + frame-stack connector LEARNS a pixel
     env, with the update jitted over a 4-device learner mesh (the
@@ -215,6 +216,7 @@ def test_ppo_pixel_env_with_learner_mesh():
 
 # ---------------------------------------------------------------- APPO
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_appo_solves_cartpole():
     import jax
 
@@ -243,6 +245,7 @@ def test_appo_solves_cartpole():
 
 # ---------------------------------------------------------------- SAC
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_sac_improves_on_pendulum():
     import jax
 

@@ -22,7 +22,9 @@ import ray_tpu
 
 cloudpickle.register_pickle_by_value(sys.modules[__name__])
 
-SOAK_S = 20
+# 8s keeps the regression class visible in tier-1 (the full-length run
+# is the 7-minute variant described above); raise locally when hunting
+SOAK_S = 8
 
 
 def test_concurrent_subsystem_churn():

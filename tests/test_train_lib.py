@@ -273,6 +273,7 @@ def test_failure_budget_exhausted(cluster, tmp_path):
         trainer.fit()
 
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_elastic_scaling_sizes_to_available(cluster, tmp_path):
     """min_workers turns on elastic sizing: ask for 6, floor 1, on an
     8-CPU cluster with 1-CPU workers the gang sizes to what fits
@@ -308,6 +309,7 @@ def test_elastic_scaling_sizes_to_available(cluster, tmp_path):
     assert 1 <= result.metrics["world"] <= 4
 
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_dataset_ingestion_sharded(cluster, tmp_path):
     """JaxTrainer(datasets=...) ships per-worker Dataset shards;
     get_dataset_shard() streams them (reference: ray.train dataset

@@ -119,6 +119,7 @@ def test_tuner_restore_completes_pending(cluster, tmp_path):
     assert all(r.metrics for r in grid2)
 
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_gpt2_tiny_lr_sweep(cluster, tmp_path):
     """The VERDICT done-criterion: sweep the GPT-2-tiny learning rate on
     CPU; best config reported (scaled to 4 trials for suite runtime)."""
@@ -203,6 +204,7 @@ def _run_population(scheduler, tmp_path, name):
     return sorted(r.metrics["score"] for r in grid)
 
 
+@pytest.mark.slow  # tier-1 budget (see ROADMAP): covered by faster siblings
 def test_pbt_beats_fixed_hyperparams(cluster, tmp_path):
     """PBT's exploit/explore lifts the population: the mean final score
     beats the same population with fixed hyperparameters."""
