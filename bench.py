@@ -114,10 +114,12 @@ def _time_steps(step, state, batch, mesh, warmup: int, steps: int):
     return state, final_loss, dt
 
 
-def main():
+def main(trace: str | None = None):
     import jax
     import jax.numpy as jnp
     import optax
+
+    from ray_tpu.util import tracing
 
     from ray_tpu.models.gpt2 import (
         GPT2Config,
@@ -165,8 +167,9 @@ def main():
     batch = jax.device_put(batch, batch_shardings(mesh, batch))
 
     step = make_train_step(lambda p, b: gpt2_loss(p, b, cfg), tx)
-    state, final_loss, dt = _time_steps(step, state, batch, mesh, warmup,
-                                        steps)
+    with tracing.span("bench.gpt2", category="bench"):
+        state, final_loss, dt = _time_steps(step, state, batch, mesh,
+                                            warmup, steps)
 
     tokens_per_sec = B * seq * steps / dt
     per_chip = tokens_per_sec / n
@@ -285,7 +288,18 @@ def main():
             }
         )
     )
+    if trace:
+        # bench runs double as profiling runs: the compile spans +
+        # bench phase spans land in a chrome trace next to the numbers
+        tracing.dump(trace)
+        print(f"# wrote trace to {trace}", flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace", default=None,
+                    help="also dump a chrome trace (spans incl. "
+                         "compiles) to this file")
+    main(trace=ap.parse_args().trace)

@@ -177,6 +177,9 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--num-blocks", type=int, default=None)
     ap.add_argument("--serve", action="store_true")
+    ap.add_argument("--trace", default=None,
+                    help="also dump a chrome trace to this file "
+                         "(merged cluster timeline in --serve mode)")
     args = ap.parse_args()
 
     extra = bench_serve_deployment(args) if args.serve \
@@ -196,6 +199,11 @@ def main():
     print(json.dumps(out))
     with open("SERVE_BENCH.json", "w") as f:
         json.dump(out, f, indent=2)
+    if args.trace:
+        from ray_tpu.util import tracing
+
+        tracing.dump(args.trace)
+        print(f"# wrote trace to {args.trace}")
 
 
 if __name__ == "__main__":

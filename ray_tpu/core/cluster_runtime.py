@@ -37,7 +37,7 @@ from ray_tpu.core.object_store import open_store
 from ray_tpu.core.options import ActorOptions, TaskOptions
 from ray_tpu.core.rpc import PeerUnavailableError, RpcClient, RpcServer
 from ray_tpu.core.specs import INLINE_THRESHOLD, ActorSpec, RefArg, TaskSpec
-from ray_tpu.utils.events import TaskEventLog
+from ray_tpu.utils.events import TaskEventLog, child_trace, merge_spans
 
 
 class _Owned:
@@ -101,13 +101,9 @@ class _Context(threading.local):
         self.trace = None
 
 
-def _child_trace(parent: dict | None) -> dict:
-    span_id = os.urandom(8).hex()
-    if parent is None:
-        return {"trace_id": os.urandom(16).hex(), "span_id": span_id,
-                "parent_id": None}
-    return {"trace_id": parent["trace_id"], "span_id": span_id,
-            "parent_id": parent["span_id"]}
+# span-context derivation lives with the event log now (utils/events.py)
+# so the local runtime and the user span API share one implementation
+_child_trace = child_trace
 
 
 class _HeldLease:
@@ -227,6 +223,7 @@ class ClusterRuntime:
                              oneway=True)
         self.server.register("pubsub", self._h_pubsub, oneway=True)
         self.server.register("list_objects", self._h_list_objects)
+        self.server.register("metrics_text", self._h_metrics_text)
         self.server.register("ping", lambda m, f: "pong")
         self.address = self.server.address
 
@@ -815,6 +812,13 @@ class ClusterRuntime:
         return fut
 
     # -- owner-side handlers --------------------------------------------------
+
+    def _h_metrics_text(self, msg, frames):
+        """This process's Prometheus page — the scrape surface the
+        nodelet's node_metrics fans out to for every worker."""
+        from ray_tpu.util.metrics import prometheus_text
+
+        return {"text": prometheus_text()}
 
     def _h_list_objects(self, msg, frames):
         """Owner-side object table for the state API (reference:
@@ -1429,6 +1433,7 @@ class ClusterRuntime:
         return norm
 
     def submit_task(self, fn, args, kwargs, opts: TaskOptions):
+        t_submit0 = time.monotonic_ns()
         streaming = opts.num_returns in ("streaming", "dynamic")
         # a streaming task has ONE sentinel return oid: it completes with
         # the item count when the generator is exhausted, and carries the
@@ -1513,6 +1518,10 @@ class ClusterRuntime:
                 self.client.call(target, "schedule_task",
                                  {"spec": dataclass_dict(spec)},
                                  timeout=60, retries=2)
+        # the submit span makes the DRIVER visible on the merged timeline
+        # and shares the task's trace context with the executor-side span
+        self._events.record(f"submit:{spec.name}", "submit", t_submit0,
+                            trace=spec.trace)
         if streaming:
             from ray_tpu.core.api import ObjectRefGenerator
 
@@ -2041,6 +2050,7 @@ class ClusterRuntime:
 
     def _submit_actor_pipelined(self, ab: bytes, task_id: bytes, msg: dict,
                                 oids):
+        t_submit0 = time.monotonic_ns()
         # flow control: bound unacked pushes (worker-side dedup window is
         # 20k; runaway submit loops must not queue unbounded memory)
         while True:
@@ -2080,6 +2090,8 @@ class ClusterRuntime:
         with self._lock:
             self._pending_acks.append(
                 [time.monotonic() + _ack_timeout(), fut, None, fail])
+        self._events.record(f"submit:{msg['method']}", "actor_submit",
+                            t_submit0, trace=msg.get("trace"))
 
     def _error_oids(self, oids, error):
         for b in oids:
@@ -2154,8 +2166,42 @@ class ClusterRuntime:
             namespace=self.namespace,
         )
 
+    def _drain_tagged_spans(self) -> list[dict]:
+        """Drain the local span buffer, stamped with this process's
+        node/proc identity — the ONE implementation of the tagging
+        contract, shared by the worker flush loop and the driver-side
+        timeline dump."""
+        spans = self._events.drain()
+        if not spans:
+            return spans
+        node = self.node_id.hex() if self.node_id else "driver"
+        proc = (self.worker_id_bytes.hex()
+                if hasattr(self, "worker_id_bytes")
+                else f"driver-{os.getpid()}")
+        for s in spans:
+            s["node"] = node
+            s["proc"] = proc
+        return spans
+
     def timeline(self, filename=None):
-        return self._events.chrome_trace(filename)
+        """MERGED cluster timeline: our local spans ride INSIDE the
+        dump request (one two-way RPC — no ordering to arrange between
+        a flush and the dump), the head appends them and returns its
+        whole span buffer — every node's workers plus this driver — as
+        one chrome trace with pid=node, tid=worker/thread and
+        epoch-aligned timestamps."""
+        spans = self._drain_tagged_spans()
+        try:
+            r = self.client.call(self.head_address, "dump_timeline",
+                                 {"spans": spans}, timeout=30)
+        except Exception:  # noqa: BLE001
+            # The failure is ambiguous (timeout and socket reset can both
+            # mean the head STORED the spans but the reply was lost), so
+            # spans are never requeued — at-most-once resolves ambiguity
+            # without ever rendering a span twice. The drained batch is
+            # still shown to THIS caller by merging it locally.
+            return merge_spans(spans, filename)
+        return merge_spans(r["spans"], filename)
 
     def context_info(self):
         return {"head_address": self.head_address, "node_id":

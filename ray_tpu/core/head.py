@@ -69,6 +69,10 @@ class Head:
         from collections import deque as _dq
 
         self._task_events = _dq(maxlen=10000)
+        # raw span buffer for the merged cluster timeline: workers and
+        # drivers flush their TaskEventLogs here over the task_events
+        # oneway channel (reference: TaskEventBuffer -> GcsTaskManager)
+        self._span_events = _dq(maxlen=50000)
         # long-poll subscriber mailboxes: sub_id -> {topics, queue, cond}
         self._poll_subs: dict = {}
         self._queue_lens: dict[bytes, int] = {}  # pending tasks per node
@@ -106,6 +110,10 @@ class Head:
         s.register("task_event", self._h_task_event, oneway=True)
         s.register("task_events", self._h_task_events, oneway=True)
         s.register("list_tasks", self._h_list_tasks)
+        # big payload / fan-out surfaces ride the slow lane so a timeline
+        # dump or metrics scrape never starves heartbeats
+        s.register("dump_timeline", self._h_dump_timeline, slow=True)
+        s.register("cluster_metrics", self._h_cluster_metrics, slow=True)
         s.register("ping", lambda m, f: "pong")
         self._monitor = threading.Thread(target=self._monitor_loop, daemon=True,
                                          name="head-monitor")
@@ -511,15 +519,58 @@ class Head:
 
     def _h_task_events(self, msg, frames):
         """Batched variant (workers buffer events; reference:
-        task_event_buffer.h periodic flush)."""
+        task_event_buffer.h periodic flush). Also the span-flush channel:
+        the same oneway carries raw TaskEventLog spans for the merged
+        cluster timeline."""
         with self._lock:
             self._task_events.extend(msg.get("events", ()))
+            self._span_events.extend(msg.get("spans", ()))
 
     def _h_list_tasks(self, msg, frames):
         limit = int(msg.get("limit", 1000))
         with self._lock:
             events = list(self._task_events)[-limit:]
         return {"tasks": events}
+
+    def _h_dump_timeline(self, msg, frames):
+        """Raw cluster-wide span buffer (reference: `ray timeline` over
+        the GCS task events). The caller's own just-drained spans ride
+        in the request and are appended first, so a one-shot dump always
+        includes them (no oneway/call ordering to rely on). Non-draining
+        otherwise: repeated dumps see history up to the buffer cap."""
+        limit = int(msg.get("limit", 50000))
+        with self._lock:
+            self._span_events.extend(msg.get("spans", ()))
+            spans = list(self._span_events)[-limit:]
+        return {"spans": spans}
+
+    # ------------------------------------------------------------ metrics
+
+    def _cluster_metrics_text(self) -> str:
+        """One Prometheus page for the whole cluster: scrape every alive
+        nodelet's node_metrics (which itself fans out to its workers)
+        and inject the node id as a label (reference: the dashboard's
+        cluster-level metrics aggregation over per-node agents)."""
+        from ray_tpu.util import metrics as _metrics
+
+        with self._lock:
+            targets = [(n.node_id.hex()[:12], n.address)
+                       for n in self._nodes.values() if n.alive]
+        pages = [({"node": "head"}, _metrics.prometheus_text())]
+        pages += _metrics.scrape_pages(self.client, targets,
+                                       "node_metrics", 10.0, "node")
+        return _metrics.merge_prometheus(pages)
+
+    def _h_cluster_metrics(self, msg, frames):
+        return {"text": self._cluster_metrics_text()}
+
+    def start_metrics_http(self, port: int = 0) -> int:
+        """Serve the cluster-wide /metrics page over HTTP from the head
+        (reference: the dashboard metrics endpoint). Returns the bound
+        port."""
+        from ray_tpu.util.metrics import serve_metrics_http
+
+        return serve_metrics_http(port, text_fn=self._cluster_metrics_text)
 
     def _h_list_actors(self, msg, frames):
         """State API source (reference: `ray list actors`,

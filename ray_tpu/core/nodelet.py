@@ -177,6 +177,39 @@ class Nodelet:
         self._last_memory_check = 0.0  # reap thread only
         self._oom_kills = 0  # surfaced in node_info; guarded_by(_lock)
 
+        # object-plane transfer observability (reference: object manager
+        # metrics), scraped cluster-wide via node_metrics. Metrics live
+        # in a PRIVATE registry: in-process test clusters run several
+        # nodelets in one process, and process-global same-name gauges
+        # would alias across nodes — per-node attribution must stay
+        # exact in exactly the topology the tests exercise.
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram, Registry
+
+        self._metrics_registry = Registry()
+        self._m_pull_bytes = Counter(
+            "object_store_pull_bytes_total",
+            "Bytes pulled into this node's store from other nodes",
+            registry=self._metrics_registry)
+        self._m_pull_seconds = Histogram(
+            "object_store_pull_seconds",
+            "Inbound object transfer latency (whole object)",
+            boundaries=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30),
+            registry=self._metrics_registry)
+        self._m_push_bytes = Counter(
+            "object_store_push_bytes_total",
+            "Bytes served out of this node's store to other nodes",
+            registry=self._metrics_registry)
+        self._m_store_allocated = Gauge(
+            "object_store_bytes_allocated", "Store bytes in use",
+            registry=self._metrics_registry)
+        self._m_store_objects = Gauge(
+            "object_store_num_objects", "Objects resident in the store",
+            registry=self._metrics_registry)
+        self._m_store_evictions = Gauge(
+            "object_store_evictions", "Cumulative store evictions "
+            "(gauge mirror of the store's counter, set at scrape)",
+            registry=self._metrics_registry)
+
         s = self.server
         s.register("schedule_task", self._h_schedule_task)
         s.register("start_actor", self._h_start_actor)
@@ -200,6 +233,7 @@ class Nodelet:
         s.register("node_info", self._h_node_info)
         # slow lane: fans out to every worker on the node
         s.register("list_node_objects", self._h_list_node_objects, slow=True)
+        s.register("node_metrics", self._h_node_metrics, slow=True)
         s.register("list_logs", self._h_list_logs)
         s.register("tail_log", self._h_tail_log)
         s.register("node_stats", self._h_node_stats)
@@ -1412,6 +1446,7 @@ class Nodelet:
     def _fetch_object_admitted(self, oid, location):
         if self.store.contains(oid):
             return {"ok": True}
+        t_fetch0 = time.monotonic()
         meta = self.client.call(location, "object_meta", {"oid": oid},
                                 timeout=15, retries=1)
         if not meta.get("ok"):
@@ -1452,6 +1487,8 @@ class Nodelet:
         # pulled copies are secondary: drop the creator pin so they are
         # LRU-evictable (the primary stays pinned on the owner's node)
         self.store.release(oid)
+        self._m_pull_bytes.inc(size)
+        self._m_pull_seconds.observe(time.monotonic() - t_fetch0)
         return {"ok": True}
 
     def _h_object_meta(self, msg, frames):
@@ -1472,6 +1509,7 @@ class Nodelet:
             return {"ok": False, "error": "absent"}
         try:
             off, n = msg["offset"], msg["size"]
+            self._m_push_bytes.inc(n)
             return {"ok": True}, [bytes(v[off:off + n])]
         finally:
             del v
@@ -1484,6 +1522,7 @@ class Nodelet:
         if v is None:
             return {"ok": False, "error": "absent"}
         try:
+            self._m_push_bytes.inc(v.nbytes)
             return {"ok": True}, [bytes(v)]
         finally:
             del v
@@ -1535,6 +1574,36 @@ class Nodelet:
                     "available": dict(self._available), "labels": self.labels,
                     "num_workers": len(self._workers),
                     "oom_kills": self._oom_kills}
+
+    def _h_node_metrics(self, msg, frames):
+        """This node's metrics page: the nodelet's PRIVATE registry
+        (store/transfer metrics — never aliased with other in-process
+        nodelets) plus every ready worker's page (scraped over the
+        metrics_text RPC), each worker tagged with its proc id so
+        same-named series from different processes stay distinct. The
+        head merges these pages cluster-wide with a node tag
+        (reference: per-node metrics agents feeding the dashboard's
+        Prometheus surface). Worker processes are real OS processes
+        even in in-process test clusters, so their attribution is
+        always exact."""
+        from ray_tpu.util import metrics as _metrics
+
+        try:
+            st = self.store.stats()
+            self._m_store_allocated.set(st.get("bytes_allocated", 0))
+            self._m_store_objects.set(st.get("num_objects", 0))
+            self._m_store_evictions.set(st.get("evictions", 0))
+        except Exception:  # noqa: BLE001
+            pass
+        with self._lock:
+            targets = [(w.worker_id.hex()[:12], w.address)
+                       for w in self._workers.values()
+                       if w.address and w.ready.is_set()]
+        pages = [({"proc": "nodelet"},
+                  _metrics.prometheus_text(self._metrics_registry))]
+        pages += _metrics.scrape_pages(self.client, targets,
+                                       "metrics_text", 5.0, "proc")
+        return {"text": _metrics.merge_prometheus(pages)}
 
     def _h_list_node_objects(self, msg, frames):
         """Aggregate this node's owner-side object tables + store stats

@@ -27,6 +27,28 @@ from ray_tpu.core import config as _cfg
 
 def default_capacity() -> int:
     return _cfg.get("OBJECT_STORE_BYTES")
+
+
+_creation_metrics = None
+
+
+def _note_create(nbytes: int) -> None:
+    """Per-process creation accounting (objects written into the shm
+    store by THIS process — workers' numbers reach the cluster /metrics
+    page via the nodelet's per-worker scrape). Lazy so importing the
+    store never drags in the metrics module."""
+    global _creation_metrics
+    m = _creation_metrics
+    if m is None:
+        from ray_tpu.util.metrics import Counter
+
+        m = _creation_metrics = (
+            Counter("object_store_created_objects_total",
+                    "Objects created in the local shm store"),
+            Counter("object_store_created_bytes_total",
+                    "Bytes of objects created in the local shm store"))
+    m[0].inc()
+    m[1].inc(nbytes)
 _TABLE_CAPACITY = 65536
 
 _SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else (
@@ -156,6 +178,7 @@ class SharedMemoryStore:
                 f"object of {size} bytes does not fit in store {self.name}")
         if rc != 0:
             raise RuntimeError(f"object table full (rc={rc})")
+        _note_create(size)
         o = off.value
         return self._shm.buf[o:o + size]
 
@@ -234,6 +257,7 @@ class SegmentPerObjectStore:
         seg.buf[8:16] = size.to_bytes(8, "little")
         with self._lock:
             self._unsealed[oid] = seg
+        _note_create(size)
         return seg.buf[self._HDR:self._HDR + size]
 
     def seal(self, oid: bytes):

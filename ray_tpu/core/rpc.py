@@ -556,6 +556,42 @@ class RpcClient:
         value, _ = self.call_frames(address, method, msg, frames, timeout, retries)
         return value
 
+    def call_gather(self, targets: list[tuple[str, str, dict]],
+                    timeout: float = 10.0) -> list:
+        """Issue one call per (address, method, msg) CONCURRENTLY and
+        gather under a single shared deadline. Returns a list aligned
+        with `targets`: the handler's value, or None for any target that
+        failed or timed out. Timed-out entries are popped from the
+        peer's pending table exactly like call_frames does, so fan-out
+        scrapes (cluster metrics) cannot leak reply futures on hung
+        peers."""
+        issued = []
+        for address, method, msg in targets:
+            try:
+                msg_id, fut = self._call_async_traced(address, method, msg)
+                issued.append((address, msg_id, fut))
+            except Exception:  # noqa: BLE001
+                issued.append(None)
+        deadline = time.monotonic() + timeout
+        out: list = []
+        for ent in issued:
+            if ent is None:
+                out.append(None)
+                continue
+            address, msg_id, fut = ent
+            try:
+                value, _ = fut.result(
+                    timeout=max(0.05, deadline - time.monotonic()))
+                out.append(value)
+            except Exception:  # noqa: BLE001
+                # timeout or peer failure: drop the pending entry so the
+                # id doesn't leak (a late reply to a popped id is ignored)
+                peer = self._peer(address)
+                with peer.pending_lock:
+                    peer.pending.pop(msg_id, None)
+                out.append(None)
+        return out
+
     def call_frames(self, address: str, method: str, msg: dict | None = None,
                     frames: list = (), timeout: float = 30.0, retries: int = 0):
         import concurrent.futures as _cf

@@ -21,7 +21,7 @@ from ray_tpu.core import exceptions as exc
 from ray_tpu.core.api import ActorHandle, ObjectRef
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.options import ActorOptions, TaskOptions
-from ray_tpu.utils.events import TaskEventLog
+from ray_tpu.utils.events import TaskEventLog, child_trace
 
 
 def make_runtime(address=None, local_mode=False, **kwargs):
@@ -89,6 +89,9 @@ class _Context(threading.local):
     def __init__(self):
         self.actor_id: ActorID | None = None
         self.task_id: TaskID | None = None
+        # active trace context — local mode threads {trace_id, span_id,
+        # parent_id} through submits exactly like the cluster runtime
+        self.trace: dict | None = None
 
 
 class LocalRuntime:
@@ -289,6 +292,9 @@ class LocalRuntime:
 
     def submit_task(self, fn: Callable, args, kwargs, opts: TaskOptions):
         streaming = opts.num_returns in ("streaming", "dynamic")
+        # child context derived on the SUBMITTING thread (the parent span
+        # is whatever is active here), adopted by the execution thread
+        trace = child_trace(self._ctx.trace)
         if streaming:
             task_id = TaskID.random()
             stream = _LocalStream()
@@ -298,6 +304,7 @@ class LocalRuntime:
 
             def run_stream():
                 self._ctx.task_id = task_id
+                self._ctx.trace = trace
                 try:
                     a, kw = self._resolve_args(args, kwargs)
                     gen = fn(*a, **kw)
@@ -322,8 +329,9 @@ class LocalRuntime:
 
         def run():
             self._ctx.task_id = task_id
+            self._ctx.trace = trace
             tries = opts.max_retries + 1 if opts.retry_exceptions else 1
-            with self._events.span(name, "task"):
+            with self._events.span(name, "task", trace=trace):
                 for attempt in range(max(1, tries)):
                     if any(s.cancelled for s in slots):
                         for s in slots:
@@ -423,8 +431,10 @@ class LocalRuntime:
                 continue
             if item is None:
                 break
-            mname, args, kwargs, slots, stream_meta = item
-            with self._events.span(f"{actor.cls.__name__}.{mname}", "actor_task"):
+            mname, args, kwargs, slots, stream_meta, trace = item
+            self._ctx.trace = trace
+            with self._events.span(f"{actor.cls.__name__}.{mname}",
+                                   "actor_task", trace=trace):
                 try:
                     a, kw = self._resolve_args(args, kwargs)
                     fn = getattr(actor.instance, mname)
@@ -482,6 +492,7 @@ class LocalRuntime:
         if actor is None:
             raise exc.ActorDiedError(f"no such actor {actor_id}")
         nr = mopts.get("num_returns", 1)
+        trace = child_trace(self._ctx.trace)
         if nr in ("streaming", "dynamic"):
             from ray_tpu.core.api import ObjectRefGenerator
 
@@ -491,7 +502,7 @@ class LocalRuntime:
                 self._streams[task_id.binary()] = stream
             meta = {"stream": stream, "bp": int(
                 mopts.get("generator_backpressure_num_objects") or 0)}
-            item = (mname, args, kwargs, [], meta)
+            item = (mname, args, kwargs, [], meta, trace)
             if actor.dead:
                 self._fail_actor_item(item, actor.death_cause
                                       or "actor is dead")
@@ -507,7 +518,7 @@ class LocalRuntime:
             for s in slots:
                 s.set_error(exc.ActorDiedError(actor.death_cause or "actor is dead"))
         else:
-            actor.inbox.put((mname, args, kwargs, slots, None))
+            actor.inbox.put((mname, args, kwargs, slots, None, trace))
             if actor.dead:
                 # lost the race with actor death: loop threads may have
                 # already drained and exited — drain again ourselves.

@@ -58,6 +58,9 @@ class WorkerRuntime(ClusterRuntime):
                          name="leased-task-exec").start()
         self._event_buf: list = []
         self._event_buf_lock = threading.Lock()
+        # consecutive flush failures (heuristic poison cap; updated from
+        # the flush loop and threshold flushes — races only skew the cap)
+        self._flush_failures = 0
         threading.Thread(target=self._event_flush_loop, daemon=True,
                          name="task-event-flush").start()
         # the lease this worker currently serves (set by the nodelet at
@@ -261,13 +264,33 @@ class WorkerRuntime(ClusterRuntime):
     def _flush_task_events(self):
         with self._event_buf_lock:
             batch, self._event_buf = self._event_buf, []
-        if not batch:
+        # raw spans ride the same oneway channel (reference: one
+        # TaskEventBuffer stream carries status AND profile events),
+        # identity-tagged by the shared drain helper so the head's
+        # merged timeline lays them out as pid=node, tid=worker
+        spans = self._drain_tagged_spans()
+        if not batch and not spans:
             return
         try:
             self.client.send_oneway(self.head_address, "task_events",
-                                    {"events": batch})
+                                    {"events": batch, "spans": spans})
         except Exception:
-            pass
+            # NOTE: oneways are best-effort by contract — send_oneway
+            # swallows delivery failures itself, so a head outage loses
+            # at most this flush window (bounded, and acceptable for
+            # observability data). This guard only catches local
+            # failures BEFORE the send (e.g. serialization), where
+            # nothing was delivered. Requeueing is CAPPED: a poisoned
+            # payload (unpicklable object smuggled into a span's trace
+            # dict) must be dropped after a few attempts or it wedges
+            # every future flush.
+            self._flush_failures += 1
+            if self._flush_failures <= 3:
+                with self._event_buf_lock:
+                    self._event_buf[:0] = batch
+                self._events.requeue(spans)
+        else:
+            self._flush_failures = 0
 
     def _event_flush_loop(self):
         while True:

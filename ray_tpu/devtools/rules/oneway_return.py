@@ -1,0 +1,100 @@
+"""GL008: oneway RPC handlers that return a value.
+
+A handler registered with ``register(<method>, fn, oneway=True)`` gets
+NO reply path — the RPC server drops whatever it returns
+(``ray_tpu/core/rpc.py`` dispatch: oneway handlers send nothing back).
+A ``return <value>`` in one is a silent contract violation: the author
+believed the caller sees an ack/result, but every caller fired and
+forgot. The bug ships green (nothing crashes) and surfaces as a
+mysteriously-ignored reply months later.
+
+Heuristic: collect ``<anything>.register(<name>, <handler>,
+oneway=True)`` calls (keyword or third positional argument) whose
+handler is a ``self._h_x`` / bare-name reference or an inline lambda,
+then flag every ``return`` WITH a non-None value in the same-module
+function of that name (lambdas: flag at the register site when the body
+is not the ``None`` constant). Bare ``return`` / ``return None`` —
+early exits — are the sanctioned oneway idiom and never flagged.
+Returns inside functions NESTED in the handler belong to the nested
+function and are ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools.context import ModuleContext
+from ray_tpu.devtools.registry import Rule, register
+
+
+def _is_true(node: ast.AST | None) -> bool:
+    return isinstance(node, ast.Constant) and node.value is True
+
+
+def _is_none(node: ast.AST | None) -> bool:
+    return node is None or (isinstance(node, ast.Constant)
+                            and node.value is None)
+
+
+def _handler_name(expr: ast.expr) -> str | None:
+    """Bare name of the handler reference: `self._h_x` -> `_h_x`,
+    `_h_x` -> `_h_x`; dynamic expressions -> None."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+@register
+class OnewayReturnRule(Rule):
+    name = "oneway-return"
+    code = "GL008"
+    description = ("handler registered oneway=True returns a value the "
+                   "RPC layer silently drops")
+    invariant = ("oneway handlers never compute replies: no caller can "
+                 "ever observe them")
+    interests = ("Call", "Return")
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        self._oneway_handlers: dict[str, ast.Call] = {}  # name -> site
+        # function name -> value-returning Return nodes in its OWN body
+        self._value_returns: dict[str, list[ast.Return]] = {}
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if isinstance(node, ast.Return):
+            fn = ctx.current_function
+            if fn is not None and not _is_none(node.value):
+                self._value_returns.setdefault(fn.name, []).append(node)
+            return
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "register"
+                and len(node.args) >= 2):
+            return
+        oneway = any(kw.arg == "oneway" and _is_true(kw.value)
+                     for kw in node.keywords)
+        if not oneway and len(node.args) >= 3:
+            oneway = _is_true(node.args[2])
+        if not oneway:
+            return
+        handler = node.args[1]
+        if isinstance(handler, ast.Lambda):
+            if not _is_none(handler.body):
+                ctx.report(self, handler,
+                           "lambda registered oneway=True returns a "
+                           "value; the RPC layer drops it — no caller "
+                           "ever sees a reply from a oneway handler")
+            return
+        name = _handler_name(handler)
+        if name is not None:
+            self._oneway_handlers.setdefault(name, node)
+
+    def end_module(self, ctx: ModuleContext) -> None:
+        for name in self._oneway_handlers:
+            for ret in self._value_returns.get(name, ()):
+                ctx.report(self, ret,
+                           f"{name} is registered oneway=True: this "
+                           "return value is silently dropped (no reply "
+                           "is ever sent) — drop the value or register "
+                           "the method two-way")

@@ -16,25 +16,37 @@ import jax.numpy as jnp
 from ray_tpu.parallel.mesh import AXIS_DATA
 
 
+# named_scope wrappers: collectives are in-trace (XLA lowers them), so
+# they cannot be wall-timed from the host — the scope name is what lets
+# the XLA/TPU profiler attribute collective time inside a step (the
+# Podracer-style compile/collective/step breakdown; see OBSERVABILITY.md)
+
+
 def psum(x, axis_name: str | tuple = AXIS_DATA):
-    return jax.lax.psum(x, axis_name)
+    with jax.named_scope("rt.psum"):
+        return jax.lax.psum(x, axis_name)
 
 
 def pmean(x, axis_name: str | tuple = AXIS_DATA):
-    return jax.lax.pmean(x, axis_name)
+    with jax.named_scope("rt.pmean"):
+        return jax.lax.pmean(x, axis_name)
+
 
 def pmax(x, axis_name: str | tuple = AXIS_DATA):
-    return jax.lax.pmax(x, axis_name)
+    with jax.named_scope("rt.pmax"):
+        return jax.lax.pmax(x, axis_name)
 
 
 def all_gather(x, axis_name: str, *, axis: int = 0, tiled: bool = True):
-    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+    with jax.named_scope("rt.all_gather"):
+        return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis_name: str, *, scatter_dimension: int = 0):
-    return jax.lax.psum_scatter(x, axis_name,
-                                scatter_dimension=scatter_dimension,
-                                tiled=True)
+    with jax.named_scope("rt.reduce_scatter"):
+        return jax.lax.psum_scatter(x, axis_name,
+                                    scatter_dimension=scatter_dimension,
+                                    tiled=True)
 
 
 def all_to_all(x, axis_name: str, *, split_axis: int, concat_axis: int):

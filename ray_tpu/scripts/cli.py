@@ -8,6 +8,8 @@ Reference parity: python/ray/scripts/scripts.py — `ray start --head`,
   python -m ray_tpu.scripts.cli start --address HOST:PORT [...]
   python -m ray_tpu.scripts.cli status  --address HOST:PORT
   python -m ray_tpu.scripts.cli list {actors|nodes|pgs} --address ...
+  python -m ray_tpu.scripts.cli timeline --address HOST:PORT -o out.json
+  python -m ray_tpu.scripts.cli metrics  --address HOST:PORT
   python -m ray_tpu.scripts.cli stop   [--session-dir DIR]
 """
 
@@ -142,6 +144,26 @@ def cmd_memory(args):
     return 0
 
 
+def cmd_timeline(args):
+    """Dump the merged cluster chrome trace (reference: `ray timeline`).
+    Open the file at chrome://tracing or ui.perfetto.dev."""
+    from ray_tpu.util import state
+
+    path = state.cluster_timeline(address=args.address,
+                                  filename=args.output)
+    print(f"wrote merged timeline to {path}")
+    return 0
+
+
+def cmd_metrics(args):
+    """Print the cluster-wide Prometheus page (node/proc tags injected;
+    the same text the head's /metrics HTTP endpoint serves)."""
+    from ray_tpu.util import state
+
+    sys.stdout.write(state.cluster_metrics(address=args.address))
+    return 0
+
+
 def cmd_logs(args):
     """Stream node logs (reference: `ray logs` over the log monitor,
     _private/log_monitor.py:103)."""
@@ -253,6 +275,17 @@ def main(argv=None):
     p = sub.add_parser("memory")
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("timeline", help="dump the merged cluster "
+                                        "chrome trace")
+    p.add_argument("--address", required=True)
+    p.add_argument("-o", "--output", default="timeline.json")
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("metrics", help="print the cluster-wide "
+                                       "Prometheus metrics page")
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_metrics)
 
     p = sub.add_parser("logs")
     p.add_argument("node", help="node id (hex prefix)")

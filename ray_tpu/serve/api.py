@@ -406,6 +406,18 @@ class ServeController:
         return True
 
 
+def _traced_submit(span_name: str, submit):
+    """Submit a handle call inside a serve span — the ONE place the
+    span naming/category/context wiring lives for every handle flavor:
+    the replica's actor-side span becomes a child of this context, so a
+    request correlates across caller and replica on the merged
+    timeline."""
+    from ray_tpu.util import tracing
+
+    with tracing.span(span_name, category="serve"):
+        return submit()
+
+
 class DeploymentHandle:
     """Client-side router (reference: DeploymentHandle + the
     power-of-two-choices replica scheduler, _private/router.py:318 —
@@ -479,11 +491,17 @@ class DeploymentHandle:
                 return self._replicas[self._rr]
 
     def remote(self, *args, **kwargs):
-        return self._pick().handle_request.remote("__call__", args, kwargs)
+        return _traced_submit(
+            f"serve.{self.app_name}",
+            lambda: self._pick().handle_request.remote("__call__", args,
+                                                       kwargs))
 
     def method(self, name: str):
         def call(*args, **kwargs):
-            return self._pick().handle_request.remote(name, args, kwargs)
+            return _traced_submit(
+                f"serve.{self.app_name}.{name}",
+                lambda: self._pick().handle_request.remote(name, args,
+                                                           kwargs))
 
         return call
 
@@ -518,13 +536,17 @@ class _StreamingHandle:
         return o
 
     def remote(self, *args, **kwargs):
-        return self._base._pick().handle_stream_request.options(
-            **self._opts()).remote("__call__", args, kwargs)
+        return _traced_submit(
+            f"serve.{self._base.app_name}",
+            lambda: self._base._pick().handle_stream_request.options(
+                **self._opts()).remote("__call__", args, kwargs))
 
     def method(self, name: str):
         def call(*args, **kwargs):
-            return self._base._pick().handle_stream_request.options(
-                **self._opts()).remote(name, args, kwargs)
+            return _traced_submit(
+                f"serve.{self._base.app_name}.{name}",
+                lambda: self._base._pick().handle_stream_request.options(
+                    **self._opts()).remote(name, args, kwargs))
 
         return call
 

@@ -35,6 +35,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import threading
+import time
 from typing import Any, Callable, NamedTuple, Sequence
 
 import jax
@@ -175,6 +176,26 @@ class ModelRunner:
         # pages are mutated functionally; serialize compute just in case
         # a stats probe races the step loop
         self._jit_lock = threading.Lock()
+        # compile observability: warmup() should account for ALL misses;
+        # a mid-stream miss afterwards is the recompile bug these catch
+        from ray_tpu.util.metrics import Counter, Histogram
+
+        self._m_compile_miss = Counter(
+            "serve_llm_compile_misses_total",
+            "Prefill/decode calls that triggered an XLA compile",
+            tag_keys=("model", "kind"))
+        self._m_compile_s = Histogram(
+            "serve_llm_compile_seconds", "XLA compile time per program",
+            boundaries=(0.1, 0.5, 1, 5, 10, 30, 60, 120),
+            tag_keys=("model", "kind"))
+
+    def _note_compile(self, kind: str, jit_fn, before: int, dt: float):
+        from ray_tpu.util import tracing
+
+        tracing.note_compile_if_grew(
+            jit_fn, before, dt, self._m_compile_miss, self._m_compile_s,
+            f"llm.compile.{kind}",
+            tags={"model": self.adapter.name, "kind": kind})
 
     # ------------------------------------------------------------- traced
 
@@ -257,11 +278,17 @@ class ModelRunner:
         block_ids[:n] = np.asarray(table, np.int32)[pos // self.block_size]
         temp = np.asarray([temperature], np.float32)
         self._step_counter += 1
+        from ray_tpu.util.tracing import jit_cache_size
+
+        before = jit_cache_size(self._prefill_jit)
+        t0 = time.perf_counter()
         with self._mesh_ctx(), self._jit_lock:
             nxt, last, self.k_pages, self.v_pages = self._prefill_jit(
                 self.params, self.k_pages, self.v_pages, toks,
                 np.int32(n - 1), block_ids, offsets, temp,
                 np.int32(self._step_counter))
+        self._note_compile("prefill", self._prefill_jit, before,
+                           time.perf_counter() - t0)
         return int(nxt), np.asarray(last)
 
     def decode(self, items: Sequence[DecodeItem]
@@ -282,10 +309,16 @@ class ModelRunner:
             tables[i, :len(it.table)] = it.table
             temps[i] = it.temperature
         self._step_counter += 1
+        from ray_tpu.util.tracing import jit_cache_size
+
+        before = jit_cache_size(self._decode_jit)
+        t0 = time.perf_counter()
         with self._mesh_ctx(), self._jit_lock:
             nxt, logits, self.k_pages, self.v_pages = self._decode_jit(
                 self.params, self.k_pages, self.v_pages, toks, poss,
                 tables, temps, np.int32(self._step_counter))
+        self._note_compile("decode", self._decode_jit, before,
+                           time.perf_counter() - t0)
         nxt = np.asarray(nxt)
         return [int(t) for t in nxt[:S]], np.asarray(logits)[:S]
 

@@ -11,6 +11,7 @@ all-gather traffic that DDP/ZeRO would do by hand.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable
 
 import jax
@@ -85,7 +86,40 @@ def make_train_step(
         )
         return new_state, {"loss": loss, "grad_norm": gnorm}
 
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    # Profiling hooks (the Podracer-style breakdown: compile vs. step —
+    # a scaling cliff usually shows up first as recompiles or step-time
+    # spread). Registry-backed, so worker-process numbers surface on the
+    # head's cluster /metrics page tagged by node.
+    from ray_tpu.util.metrics import Counter, Histogram
+
+    m_step = Histogram(
+        "train_step_seconds",
+        "Host-side train-step dispatch time (includes device wait on "
+        "synchronous backends)",
+        boundaries=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60))
+    m_miss = Counter(
+        "train_compile_misses_total",
+        "Train steps that triggered an XLA compile (new shape/sharding)")
+    m_compile = Histogram(
+        "train_compile_seconds", "XLA compile time for the train step",
+        boundaries=(0.1, 0.5, 1, 5, 10, 30, 60, 120, 300))
+
+    def instrumented(state: TrainState, batch: PyTree):
+        from ray_tpu.util import tracing
+
+        before = tracing.jit_cache_size(jitted)
+        t0 = time.perf_counter()
+        out = jitted(state, batch)
+        dt = time.perf_counter() - t0
+        if not tracing.note_compile_if_grew(jitted, before, dt, m_miss,
+                                            m_compile, "train.compile"):
+            m_step.observe(dt)
+        return out
+
+    instrumented.jitted = jitted  # AOT access (lower/compile) if needed
+    return instrumented
 
 
 def init_sharded_state(

@@ -191,21 +191,34 @@ class WorkerGroup:
     def execute(self, method: str, *args, timeout: float | None = 120.0,
                 **kwargs) -> list:
         import ray_tpu
+        from ray_tpu.util import tracing
 
-        refs = [getattr(w, method).remote(*args, **kwargs)
-                for w in self.workers]
-        return ray_tpu.get(refs, timeout=timeout)
+        # one span per gang call: every rank's actor-side span carries a
+        # child of this context, so the merged timeline shows the whole
+        # gang under one trace_id (straggler ranks stick out)
+        with tracing.span(f"worker_group.{method}", category="train"):
+            refs = [getattr(w, method).remote(*args, **kwargs)
+                    for w in self.workers]
+            return ray_tpu.get(refs, timeout=timeout)
 
     def execute_single(self, rank: int, method: str, *args,
                        timeout: float | None = 120.0, **kwargs) -> Any:
         import ray_tpu
+        from ray_tpu.util import tracing
 
-        ref = getattr(self.workers[rank], method).remote(*args, **kwargs)
-        return ray_tpu.get(ref, timeout=timeout)
+        with tracing.span(f"worker_group.{method}[{rank}]",
+                          category="train"):
+            ref = getattr(self.workers[rank], method).remote(*args,
+                                                             **kwargs)
+            return ray_tpu.get(ref, timeout=timeout)
 
     def execute_async(self, method: str, *args, **kwargs) -> list:
-        return [getattr(w, method).remote(*args, **kwargs)
-                for w in self.workers]
+        from ray_tpu.util import tracing
+
+        with tracing.span(f"worker_group.{method}.submit",
+                          category="train"):
+            return [getattr(w, method).remote(*args, **kwargs)
+                    for w in self.workers]
 
     def shutdown(self):
         import ray_tpu

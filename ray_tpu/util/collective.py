@@ -244,10 +244,27 @@ def _get(group_name) -> _GroupState:
     return g
 
 
+_coll_hist = None
+
+
+def _collective_seconds():
+    global _coll_hist
+    if _coll_hist is None:
+        from ray_tpu.util.metrics import Histogram
+
+        _coll_hist = Histogram(
+            "collective_seconds",
+            "Host-side collective wall time (offer -> result ready)",
+            boundaries=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30),
+            tag_keys=("op",))
+    return _coll_hist
+
+
 def _sync(g: _GroupState, kind, data, op=None, root=None,
           timeout: float = 120.0):
     import ray_tpu
 
+    t0 = time.perf_counter()
     seq = g.next_seq()
     ray_tpu.get(g.coordinator.offer.remote(kind, seq, g.rank, data, op, root),
                 timeout=60)
@@ -257,6 +274,12 @@ def _sync(g: _GroupState, kind, data, op=None, root=None,
         ready, result = ray_tpu.get(g.coordinator.poll.remote(kind, seq),
                                     timeout=60)
         if ready:
+            dt = time.perf_counter() - t0
+            _collective_seconds().observe(dt, tags={"op": kind})
+            from ray_tpu.util import tracing
+
+            tracing.record_span(f"collective.{kind}", dt,
+                                category="collective")
             return result
         time.sleep(sleep)
         sleep = min(sleep * 2, 0.05)

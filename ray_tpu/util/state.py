@@ -2,8 +2,8 @@
 
 Reference parity: ray.util.state (python/ray/util/state/api.py —
 list_actors/list_nodes/list_placement_groups; task events feed `ray list
-tasks` in the reference; here per-process task events are exported via
-ray_tpu.timeline())."""
+tasks`); cluster_timeline/cluster_metrics expose the merged tracing +
+metrics plane (see OBSERVABILITY.md)."""
 
 from __future__ import annotations
 
@@ -47,6 +47,25 @@ def list_tasks(address: str | None = None, limit: int = 1000) -> list[dict]:
     GcsTaskManager task events)."""
     return _head_call("list_tasks", {"limit": limit},
                       address=address)["tasks"]
+
+
+def cluster_metrics(address: str | None = None) -> str:
+    """One Prometheus page for the whole cluster: the head scrapes every
+    alive nodelet (which fans out to its workers) and injects node/proc
+    tags (reference: the dashboard's cluster metrics aggregation)."""
+    return _head_call("cluster_metrics", address=address)["text"]
+
+
+def cluster_timeline(address: str | None = None,
+                     filename: str | None = None):
+    """The merged cluster chrome trace from the head's span buffer
+    (pid = node, tid = worker/thread, epoch-aligned timestamps). In a
+    connected driver prefer `ray_tpu.timeline()`, which also flushes the
+    driver's own spans first."""
+    from ray_tpu.utils.events import merge_spans
+
+    spans = _head_call("dump_timeline", address=address)["spans"]
+    return merge_spans(spans, filename)
 
 
 def _node_address(node_id: str, address: str | None) -> str:

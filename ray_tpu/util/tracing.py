@@ -1,0 +1,114 @@
+"""User-facing tracing: nested in-task spans + trace-context access.
+
+Reference parity: ray.util.tracing (tracing_helper.py:34 span
+propagation), minus the OpenTelemetry dependency — spans land in the
+process's TaskEventLog and flow to the head's cluster-wide span buffer,
+so `ray_tpu.timeline()` shows them on the merged timeline next to the
+runtime's own task/actor spans.
+
+    from ray_tpu.util import tracing
+
+    with tracing.span("preprocess"):          # inside a task, a driver,
+        with tracing.span("tokenize"):        # or plain local code
+            ...
+
+Entering a span makes it the CURRENT trace context: tasks/actor calls
+submitted inside it carry a child context, so a whole driver→actor→task
+chain shares one trace_id (correlate with the `args` on timeline spans).
+Works without an initialized runtime too (bench scripts, bare engines):
+spans then collect in a process-local fallback log that `dump()`
+exports."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+from ray_tpu.utils.events import TaskEventLog, child_trace
+
+# spans recorded before/without ray_tpu.init() (bench.py, bare LLMEngine)
+_fallback_log = TaskEventLog()
+_fallback_ctx = threading.local()
+
+
+def _runtime():
+    from ray_tpu.core import api
+
+    return api._runtime
+
+
+def _ctx_and_log():
+    rt = _runtime()
+    if rt is not None and hasattr(rt, "_ctx") and hasattr(rt, "_events"):
+        return rt._ctx, rt._events
+    return _fallback_ctx, _fallback_log
+
+
+def current_trace() -> dict | None:
+    """The active {trace_id, span_id, parent_id} context, if any."""
+    ctx, _ = _ctx_and_log()
+    return getattr(ctx, "trace", None)
+
+
+@contextlib.contextmanager
+def span(name: str, category: str = "user"):
+    """Record a span around the enclosed block and make it the current
+    trace context (children — nested spans, submitted tasks, actor
+    calls — link to it). Yields the span's trace context."""
+    ctx, log = _ctx_and_log()
+    parent = getattr(ctx, "trace", None)
+    trace = child_trace(parent)
+    ctx.trace = trace
+    try:
+        with log.span(name, category, trace=trace):
+            yield trace
+    finally:
+        ctx.trace = parent
+
+
+def record_span(name: str, duration_s: float, category: str = "user",
+                trace: dict | None = None) -> None:
+    """Log an already-measured span ending now (for code that timed
+    itself — compile hooks, collective wrappers)."""
+    _, log = _ctx_and_log()
+    t1 = time.monotonic_ns()
+    log.record(name, category, t1 - int(duration_s * 1e9), t1,
+               trace=trace or current_trace())
+
+
+def jit_cache_size(jit_fn) -> int:
+    """Compiled-program count of a `jax.jit` callable, or -1 when the
+    (private) `_cache_size` API is unavailable. The ONE wrapper around
+    that private API — every compile-miss probe (train/spmd.py,
+    serve/llm/runner.py) goes through here, so a JAX upgrade breaks
+    exactly one call site."""
+    try:
+        return jit_fn._cache_size()
+    except Exception:  # noqa: BLE001
+        return -1
+
+
+def note_compile_if_grew(jit_fn, before: int, duration_s: float,
+                         miss_counter, compile_hist, span_name: str,
+                         tags: dict | None = None) -> bool:
+    """The compile-miss protocol, in one place: if `jit_fn`'s cache grew
+    past the `before` reading, account `duration_s` as a compile (miss
+    counter + compile histogram + a compile-category span) and return
+    True; otherwise return False (the caller accounts a normal step)."""
+    if before < 0 or jit_cache_size(jit_fn) <= before:
+        return False
+    miss_counter.inc(tags=tags)
+    compile_hist.observe(duration_s, tags=tags)
+    record_span(span_name, duration_s, category="compile")
+    return True
+
+
+def dump(filename: str):
+    """Write this process's trace to `filename`: the merged cluster
+    timeline when a runtime is initialized, else the fallback log
+    (bench scripts without a cluster)."""
+    rt = _runtime()
+    if rt is not None and hasattr(rt, "timeline"):
+        return rt.timeline(filename)
+    return _fallback_log.chrome_trace(filename)

@@ -1,5 +1,14 @@
 """Task-event log → Chrome trace (reference: task events pipeline,
-core_worker/task_event_buffer.h → `ray timeline`)."""
+core_worker/task_event_buffer.h → `ray timeline`).
+
+Timestamp contract (the epoch-anchoring rule every span producer must
+follow, see OBSERVABILITY.md): spans are TIMED with the monotonic clock
+(durations never go backwards under NTP slew) but STAMPED on the epoch
+wall clock, via a wall−monotonic offset recorded once per process at
+import. That makes `ts` values comparable across processes and nodes —
+the property a merged cluster timeline needs — while `dur` stays a pure
+monotonic difference. Chrome-trace units: microseconds for both.
+"""
 
 from __future__ import annotations
 
@@ -7,6 +16,34 @@ import contextlib
 import json
 import threading
 import time
+
+# Wall−monotonic offset in microseconds, sampled ONCE per process: every
+# span in this process shares the same anchor, so intra-process ordering
+# is exactly monotonic ordering; cross-process alignment is as good as
+# the hosts' wall clocks (NTP-class, ~ms — plenty for locating a
+# straggler in a multi-second train step).
+_WALL_ANCHOR_US = time.time_ns() / 1e3 - time.monotonic_ns() / 1e3
+
+
+def epoch_us(monotonic_ns: int | None = None) -> float:
+    """Epoch-anchored microseconds for a monotonic_ns reading (now if
+    omitted)."""
+    if monotonic_ns is None:
+        monotonic_ns = time.monotonic_ns()
+    return monotonic_ns / 1e3 + _WALL_ANCHOR_US
+
+
+def child_trace(parent: dict | None) -> dict:
+    """New span context under `parent` (OTel-style propagation —
+    reference: tracing_helper.py:34). A None parent starts a trace."""
+    import os
+
+    span_id = os.urandom(8).hex()
+    if parent is None:
+        return {"trace_id": os.urandom(16).hex(), "span_id": span_id,
+                "parent_id": None}
+    return {"trace_id": parent["trace_id"], "span_id": span_id,
+            "parent_id": parent["span_id"]}
 
 
 class TaskEventLog:
@@ -22,25 +59,48 @@ class TaskEventLog:
         ray/util/tracing/tracing_helper.py:34) — recorded as chrome-trace
         args so cross-process spans of one logical request correlate."""
         t0 = time.monotonic_ns()
-        tid = threading.get_ident()
         try:
             yield
         finally:
-            t1 = time.monotonic_ns()
-            ev = {
-                "name": name,
-                "cat": category,
-                "ph": "X",
-                "ts": t0 / 1e3,
-                "dur": (t1 - t0) / 1e3,
-                "pid": 0,
-                "tid": tid,
-            }
-            if trace:
-                ev["args"] = dict(trace)
-            with self._lock:
-                if len(self._events) < self._capacity:
-                    self._events.append(ev)
+            self.record(name, category, t0, time.monotonic_ns(),
+                        trace=trace)
+
+    def record(self, name: str, category: str, t0_ns: int,
+               t1_ns: int | None = None, trace: dict | None = None):
+        """Append one completed span timed by the caller (monotonic_ns
+        endpoints); `ts` is epoch-anchored at append."""
+        if t1_ns is None:
+            t1_ns = time.monotonic_ns()
+        ev = {
+            "name": name,
+            "cat": category,
+            "ph": "X",
+            "ts": epoch_us(t0_ns),
+            "dur": (t1_ns - t0_ns) / 1e3,
+            "pid": 0,
+            "tid": threading.get_ident(),
+        }
+        if trace:
+            ev["args"] = dict(trace)
+        with self._lock:
+            if len(self._events) < self._capacity:
+                self._events.append(ev)
+
+    def drain(self) -> list[dict]:
+        """Take (and clear) the buffered spans — the flush primitive:
+        workers/drivers drain into the head's cluster-wide span buffer."""
+        with self._lock:
+            events, self._events = self._events, []
+        return events
+
+    def requeue(self, events: list[dict]) -> None:
+        """Put drained spans back (a flush whose delivery failed must
+        not lose them); capacity still bounds the buffer."""
+        if not events:
+            return
+        with self._lock:
+            room = max(0, self._capacity - len(self._events))
+            self._events[:0] = events[-room:] if room else []
 
     def chrome_trace(self, filename: str | None = None):
         with self._lock:
@@ -50,3 +110,45 @@ class TaskEventLog:
                 json.dump(events, f)
             return filename
         return events
+
+
+def merge_spans(spans: list[dict], filename: str | None = None):
+    """Merge raw span dicts (each tagged with the producing `node` and
+    `proc` at flush time) into one Chrome trace: `pid` = node, `tid` =
+    (worker process, thread) — the reference's `ray timeline` shape, so
+    one page shows every node's workers on a shared epoch-aligned axis.
+    Metadata events name the rows. Returns the event list (or writes
+    `filename` and returns it)."""
+    pids: dict[str, int] = {}
+    tids: dict[tuple, int] = {}
+    meta: list[dict] = []
+    events: list[dict] = []
+    for s in spans:
+        node = str(s.get("node") or "unknown")
+        pid = pids.get(node)
+        if pid is None:
+            pid = pids[node] = len(pids) + 1
+            meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                         "args": {"name": f"node:{node[:16]}"}})
+        proc = str(s.get("proc") or "")
+        tkey = (pid, proc, s.get("tid", 0))
+        tid = tids.get(tkey)
+        if tid is None:
+            tid = tids[tkey] = len(tids) + 1
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                         "tid": tid,
+                         "args": {"name": f"{proc[:12] or 'main'}"
+                                          f":{s.get('tid', 0)}"}})
+        ev = {"name": s.get("name", ""), "cat": s.get("cat", ""),
+              "ph": s.get("ph", "X"), "ts": s.get("ts", 0.0),
+              "dur": s.get("dur", 0.0), "pid": pid, "tid": tid}
+        if s.get("args"):
+            ev["args"] = s["args"]
+        events.append(ev)
+    events.sort(key=lambda e: e["ts"])
+    out = meta + events
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(out, f)
+        return filename
+    return out

@@ -1,0 +1,27 @@
+"""GL008 firing fixture: oneway handlers that return dropped values."""
+
+
+class Service:
+    def __init__(self, server):
+        self.server = server
+        server.register("task_done", self._h_task_done, oneway=True)
+        server.register("heartbeat", self._h_heartbeat, oneway=True)
+        server.register("ping", lambda m, f: "pong", oneway=True)  # FIRE
+
+    def _h_task_done(self, msg, frames):
+        if not msg:
+            return  # bare early exit: fine
+        return {"ok": True}  # FIRE: reply silently dropped
+
+    def _h_heartbeat(self, msg, frames):
+        self._beat = msg["t"]
+        return msg["t"]  # FIRE: oneway via positional-style keyword
+
+
+def wire(server):
+    server.register("free_object", handler, True)  # positional oneway
+    return server
+
+
+def handler(msg, frames):
+    return len(msg)  # FIRE: registered oneway positionally above

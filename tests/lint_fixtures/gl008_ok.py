@@ -1,0 +1,25 @@
+"""GL008 non-firing fixture: clean oneway and two-way handlers."""
+
+
+class Service:
+    def __init__(self, server):
+        self.server = server
+        server.register("task_done", self._h_task_done, oneway=True)
+        server.register("resolve", self._h_resolve)  # two-way: replies fine
+        server.register("ping", lambda m, f: "pong")  # two-way lambda
+        server.register("noop", lambda m, f: None, oneway=True)
+
+    def _h_task_done(self, msg, frames):
+        if not msg:
+            return  # bare early exits are the oneway idiom
+        self._last = msg
+        return None  # explicit None: nothing dropped
+
+    def _h_resolve(self, msg, frames):
+        def helper():
+            return {"nested": True}  # nested fn, not the handler
+
+        return helper()  # two-way handler replying is the whole point
+
+    def _h_mixed(self, msg, frames):
+        return {"ok": True}  # never registered oneway: quiet

@@ -36,6 +36,7 @@ RULE_CASES = [
     ("GL005", "guarded-by", "gl005_fire.py", "gl005_ok.py", 3),
     ("GL006", "except-hygiene", "gl006_fire.py", "gl006_ok.py", 3),
     ("GL007", "unreleased-store-ref", "gl007_fire.py", "gl007_ok.py", 3),
+    ("GL008", "oneway-return", "gl008_fire.py", "gl008_ok.py", 4),
 ]
 
 
@@ -56,7 +57,8 @@ def test_rule_fires_and_stays_quiet(code, name, fire, ok, n_expected):
 def test_rule_catalog_complete():
     catalog = rule_catalog()
     assert [c.code for c in catalog] == [
-        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007"]
+        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
+        "GL008"]
     for cls in catalog:
         assert cls.name and cls.description and cls.invariant
 

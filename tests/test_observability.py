@@ -101,6 +101,87 @@ def test_cli_status_and_list(cluster):
     assert rows and rows[0]["alive"]
 
 
+def test_inject_labels_forms():
+    assert m.inject_labels("hits 3.0", {"node": "abc"}) == \
+        'hits{node="abc"} 3.0'
+    assert m.inject_labels('lat_bucket{le="0.1"} 1', {"node": "n"}) == \
+        'lat_bucket{le="0.1",node="n"} 1'
+    # two tags, sorted for stable output
+    assert m.inject_labels("x 1", {"proc": "w", "node": "n"}) == \
+        'x{node="n",proc="w"} 1'
+    # a key the series already carries is NOT duplicated (duplicate
+    # label names are invalid exposition format)
+    assert m.inject_labels('x{proc="mine"} 1', {"proc": "w", "node": "n"}) \
+        == 'x{proc="mine",node="n"} 1'
+
+
+def test_merge_prometheus_dedupes_meta_and_tags_pages():
+    page = ("# HELP hits h\n# TYPE hits counter\nhits 1.0\n")
+    merged = m.merge_prometheus([({"node": "a"}, page),
+                                 ({"node": "b"}, page)])
+    assert merged.count("# TYPE hits counter") == 1
+    assert 'hits{node="a"} 1.0' in merged
+    assert 'hits{node="b"} 1.0' in merged
+
+
+def test_merge_prometheus_groups_families_contiguously():
+    """Standard parsers demote samples separated from their TYPE header
+    to untyped: a family on 2+ pages must merge into ONE header with
+    all samples directly under it (histograms especially — _bucket/_sum/
+    _count lines carry suffixed names)."""
+    h = m.Histogram("mp_lat", "l", boundaries=(1.0,))
+    h.observe(0.5)
+    c = m.Counter("mp_hits", "h")
+    c.inc()
+    page = m.prometheus_text()
+    merged = m.merge_prometheus([({"node": "a"}, page),
+                                 ({"node": "b"}, page)])
+    lines = merged.splitlines()
+    start = lines.index("# TYPE mp_lat histogram")
+    block = lines[start + 1:start + 7]  # 3 sample lines x 2 pages
+    assert all(l.startswith("mp_lat") for l in block), block
+    assert sum(1 for l in lines if l.startswith("# TYPE mp_lat")) == 1
+    # the counter family survives as its own contiguous block too
+    assert 'mp_hits{node="a"} 1.0' in merged
+    assert 'mp_hits{node="b"} 1.0' in merged
+
+
+def test_nested_span_api_links_and_epoch_anchor(cluster):
+    """util.tracing.span: nesting produces parent-linked spans sharing
+    one trace_id, with epoch-anchored (wall-clock-comparable) ts."""
+    import time as _t
+
+    from ray_tpu.util import tracing
+
+    with tracing.span("t_outer") as t_o:
+        with tracing.span("t_inner") as t_i:
+            pass
+    assert t_i["trace_id"] == t_o["trace_id"]
+    assert t_i["parent_id"] == t_o["span_id"]
+    events = {e["name"]: e for e in ray_tpu.timeline()
+              if e.get("ph") == "X"}
+    assert events["t_inner"]["args"]["parent_id"] == \
+        events["t_outer"]["args"]["span_id"]
+    # the epoch-anchoring contract (the old monotonic-only ts bug):
+    # span timestamps must be comparable to wall-clock time
+    assert abs(events["t_outer"]["ts"] - _t.time() * 1e6) < 300e6
+
+
+def test_span_context_threads_into_tasks(cluster):
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote(num_cpus=0.1)
+    def probe():
+        from ray_tpu.util import tracing as _tr
+
+        return _tr.current_trace()
+
+    with tracing.span("t_root") as root:
+        child = ray_tpu.get(probe.remote(), timeout=60)
+    assert child["trace_id"] == root["trace_id"]
+    assert child["parent_id"] == root["span_id"]
+
+
 def test_histogram_recreation_shares_state():
     h1 = m.Histogram("shared_lat", "l", boundaries=(1.0,))
     h1.observe(0.5)

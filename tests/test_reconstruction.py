@@ -10,14 +10,38 @@ import ray_tpu
 from ray_tpu.cluster_utils import Cluster
 
 
-@pytest.fixture
-def two_node_cluster():
+@pytest.fixture(scope="module")
+def _module_cluster():
+    """ONE head + stable node + driver for the whole module (tier-1
+    wall-time lever, see ROADMAP): cluster boot + init + worker warmup
+    are paid once instead of per test."""
     c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
     c.wait_for_nodes()
     ray_tpu.init(address=c.address)
     yield c
     ray_tpu.shutdown()
     c.shutdown()
+
+
+@pytest.fixture
+def two_node_cluster(_module_cluster):
+    c = _module_cluster
+    yield c
+    # tests add (and kill) volatile nodes; strip everything but the
+    # stable head node and wait for the head to age the dead ones out,
+    # so every test starts from the same 1-alive-node state a fresh
+    # cluster would give it
+    for nl in list(c.nodelets[1:]):
+        try:
+            c.remove_node(nl)
+        except Exception:  # noqa: BLE001
+            pass
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if sum(1 for n in ray_tpu.nodes() if n["Alive"]) == 1:
+            return
+        time.sleep(0.2)
+    raise RuntimeError("extra nodes did not age out of the cluster view")
 
 
 def test_lost_object_reconstructed_after_node_death(two_node_cluster):

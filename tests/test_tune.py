@@ -16,6 +16,9 @@ cloudpickle.register_pickle_by_value(sys.modules[__name__])
 
 @pytest.fixture(scope="module")
 def cluster():
+    """Module-scoped on purpose (tier-1 wall-time lever, see ROADMAP):
+    every test shares one head + nodelet + driver; trials only ever add
+    actors, never nodes, so no per-test cluster surgery is needed."""
     c = Cluster(initialize_head=True, head_node_args={"num_cpus": 8})
     c.wait_for_nodes()
     ray_tpu.init(address=c.address)
