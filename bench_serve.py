@@ -2,23 +2,42 @@
 
 Open-loop (arrivals don't wait for completions, Poisson
 inter-arrivals) is the honest serving shape: closed-loop benchmarks
-self-throttle and hide queueing collapse. Emits one BENCH-style JSON
-line (headline: generated tokens/s; secondary: p50/p99 TTFT) and
-writes SERVE_BENCH.json, so future PRs have a serving perf
-trajectory next to bench.py's training numbers.
+self-throttle and hide queueing collapse. Two scenarios:
+
+- **open-loop** (headline): random prompts, fresh every sample —
+  measures raw continuous-batching throughput + TTFT;
+- **shared-prefix**: N requests sharing one long common prefix (the
+  RL-rollout / system-prompt shape), run twice — once against a
+  cold engine with prefix caching DISABLED and once against a warm
+  prefix cache — so the automatic-prefix-caching win is measured
+  against its own cold baseline.
+
+Both scenarios follow the PERF_NOTES round-5 recipe instead of
+single-shot numbers: idle-gate (wait for loadavg < 0.7), median of 7
+samples with a stdev field, and retry-on-variance (re-measure up to 3
+attempts when stdev > 8% of the median, keep the steadiest attempt).
+
+Emits one BENCH-style JSON line (headline: generated tokens/s;
+secondary: TTFT p50/p99 and the warm/cold shared-prefix TTFTs) and
+writes SERVE_BENCH.json, so future PRs have a serving perf trajectory
+next to bench.py's training numbers.
 
     python bench_serve.py [--n 64] [--rate 8] [--model gpt2]
                           [--preset tiny] [--max-tokens 16] [--serve]
+                          [--samples 7] [--skip-shared-prefix]
 
 Default drives a bare in-process engine (scheduler+runner+cache, no
-RPC). `--serve` runs the same load through a real serve deployment and
-DeploymentHandle streaming instead — engine + serve overhead together.
+RPC). `--serve` runs the open-loop load through a real serve
+deployment and DeploymentHandle streaming instead — engine + serve
+overhead together (single-shot: RPC latency dominates, the recipe's
+variance control buys little there).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import statistics
 import threading
 import time
 
@@ -31,34 +50,59 @@ def _requests(n, seed, max_len=32):
             for _ in range(n)]
 
 
-def bench_engine(args) -> dict:
-    from ray_tpu.serve.llm import EngineConfig, LLMEngine, SamplingParams
+def _wait_for_idle(max_wait_s: float = 240.0, load_thresh: float = 0.7):
+    """Idle-gate (PERF_NOTES round 5): this bench is contention-
+    sensitive on a 1-core VM, so wait for the load average to settle
+    before measuring."""
+    import os
 
-    eng = LLMEngine(EngineConfig(
-        model=args.model, preset=args.preset, block_size=16,
-        max_model_len=args.max_model_len, max_batch_size=args.batch,
-        num_blocks=args.num_blocks))
-    prompts = _requests(args.n, seed=0, max_len=args.max_model_len // 2)
-    sp = SamplingParams(max_tokens=args.max_tokens)
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < max_wait_s:
+        try:
+            load1 = os.getloadavg()[0]
+        except OSError:
+            return 0.0
+        if load1 < load_thresh:
+            return time.monotonic() - t0
+        time.sleep(5.0)
+    return time.monotonic() - t0
 
-    # compile every bucketed program outside the measured window
-    eng.warmup()
 
-    stop = threading.Event()
+def _recipe(run_sample, *, samples: int, control_key: str,
+            attempts: int = 3) -> dict:
+    """Round-5 measurement recipe: idle gate, median-of-`samples` for
+    every numeric metric the sample returns, stdev + relative stdev on
+    `control_key`, retry-on-variance (keep the steadiest attempt)."""
+    best = None
+    for attempt in range(attempts):
+        waited = _wait_for_idle()
+        rows = [run_sample(i) for i in range(samples)]
+        keys = [k for k, v in rows[0].items()
+                if isinstance(v, (int, float))]
+        agg = {k: float(statistics.median([r[k] for r in rows]))
+               for k in keys}
+        ctl = [r[control_key] for r in rows]
+        med = statistics.median(ctl)
+        sd = statistics.pstdev(ctl)
+        agg.update({
+            f"{control_key}_stdev": sd,
+            "rel_stdev": (sd / med) if med else 1e9,
+            "samples": samples,
+            "attempt": attempt + 1,
+            "idle_wait_s": round(waited, 1),
+        })
+        if best is None or agg["rel_stdev"] < best["rel_stdev"]:
+            best = agg
+        if agg["rel_stdev"] <= 0.08:
+            break
+    return best
 
-    def step_loop():
-        while not stop.is_set():
-            if not eng.step():
-                time.sleep(0.0005)
 
-    stepper = threading.Thread(target=step_loop, daemon=True)
-    stepper.start()
-
-    # one reader thread per stream: TTFT is measured at first-token
-    # ARRIVAL, concurrent with the open-loop arrivals — a sequential
-    # post-hoc drain would just re-measure the enqueue schedule
-    rng = np.random.RandomState(1)
-    n = args.n
+def _drive_open_loop(eng, prompts, sp, rate, seed) -> dict:
+    """Submit `prompts` open-loop (Poisson at `rate` req/s) against a
+    running engine; one reader thread per stream so TTFT is measured at
+    first-token ARRIVAL, concurrent with the arrivals."""
+    n = len(prompts)
     ttft = [float("nan")] * n
     finals = [None] * n
 
@@ -73,6 +117,7 @@ def bench_engine(args) -> dict:
         except Exception:  # noqa: BLE001  (stalled engine: leave None)
             pass
 
+    rng = np.random.RandomState(seed)
     readers = []
     t0 = time.monotonic()
     for i, p in enumerate(prompts):
@@ -81,29 +126,150 @@ def bench_engine(args) -> dict:
         th = threading.Thread(target=consume, args=(i, s, te), daemon=True)
         th.start()
         readers.append(th)
-        time.sleep(float(rng.exponential(1.0 / args.rate)))
+        time.sleep(float(rng.exponential(1.0 / rate)))
     for th in readers:
         th.join(timeout=300)
     wall = time.monotonic() - t0
-    stop.set()
-    stepper.join(timeout=5)
 
     n_tokens = sum(f["num_generated"] for f in finals if f)
     dropped = sum(1 for f in finals
                   if f is None or f["finish_reason"].startswith("error"))
-    st = eng.stats()
     return {
         "tokens_per_sec": n_tokens / wall,
         "ttft_p50_ms": float(np.nanpercentile(ttft, 50)),
         "ttft_p99_ms": float(np.nanpercentile(ttft, 99)),
-        "requests": args.n,
+        "requests": n,
         "dropped": dropped,
         "wall_s": wall,
         "total_tokens": n_tokens,
+    }
+
+
+def _mk_engine(args, **overrides):
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine
+
+    cfg = dict(model=args.model, preset=args.preset, block_size=16,
+               max_model_len=args.max_model_len, max_batch_size=args.batch,
+               num_blocks=args.num_blocks)
+    cfg.update(overrides)
+    eng = LLMEngine(EngineConfig(**cfg))
+    eng.warmup()  # compile every bucketed program outside measurement
+    stop = threading.Event()
+
+    def step_loop():
+        while not stop.is_set():
+            if not eng.step():
+                time.sleep(0.0005)
+
+    threading.Thread(target=step_loop, daemon=True).start()
+    return eng, stop
+
+
+def bench_engine(args) -> dict:
+    from ray_tpu.serve.llm import SamplingParams
+
+    eng, stop = _mk_engine(args)
+    sp = SamplingParams(max_tokens=args.max_tokens)
+
+    def sample(i):
+        # fresh prompts every sample: the open-loop scenario must stay
+        # prefix-cache-cold or it would quietly measure the warm path
+        prompts = _requests(args.n, seed=1000 + i,
+                            max_len=args.max_model_len // 2)
+        return _drive_open_loop(eng, prompts, sp, args.rate, seed=i)
+
+    out = _recipe(sample, samples=args.samples,
+                  control_key="tokens_per_sec")
+    st = eng.stats()
+    stop.set()
+    out.update({
         "preemptions": st["preemptions"],
         "compiled_programs": st["compiled_programs"],
         "mode": "engine",
-    }
+    })
+    return out
+
+
+def bench_shared_prefix(args) -> dict:
+    """N requests x one long common prefix. Cold = prefix caching
+    disabled (every request pays the full prefill); warm = caching on,
+    cache primed. The acceptance gate compares warm TTFT p50 against
+    the cold run's."""
+    from ray_tpu.serve.llm import SamplingParams
+
+    rng = np.random.RandomState(77)
+    # the scenario runs its own engine with a context of >= 512: the
+    # cold/warm contrast is the prefix's prefill COMPUTE, which must
+    # dominate the fixed per-step dispatch overhead (~ms on this box)
+    # that both sides pay per request — a 96-token prefix on the tiny
+    # preset is below that floor and the measured ratio degenerates to
+    # overhead/overhead regardless of how much prefill was skipped
+    ctx_len = max(args.max_model_len, 512)
+    prefix_len = int(ctx_len * 0.75)
+    prefix = rng.randint(1, 500, size=prefix_len).tolist()
+    # stretch the preset's positional range to the scenario context
+    import dataclasses
+
+    from ray_tpu.serve.llm.runner import adapters
+
+    model_cfg = dataclasses.replace(
+        adapters()[args.model].presets[args.preset](), block_size=ctx_len)
+    suffix_len = 4
+    # TTFT is a PREFILL metric: any decode tail adds identical work to
+    # both runs, and with a burst deeper than max_batch_size it comes
+    # to DOMINATE slot turnover — queued requests then wait on
+    # predecessors' decodes, not their prefills, and the cold/warm
+    # contrast drowns. One token per request keeps slot turnover pure
+    # prefill (the first token is sampled from the final chunk's
+    # logits; no decode step runs at all).
+    sp = SamplingParams(max_tokens=1)
+    # 32 bursty requests per sample: the cold/warm contrast is one
+    # ~prefix_len prefill per request, which at 16 requests is the same
+    # order as this box's scheduler jitter — a deeper queue amplifies
+    # the contrast and steadies the per-sample percentiles
+    n = min(args.n, 32)
+    # TRUE burst arrivals (zero inter-arrival gap): the shared-prefix
+    # shape IS the burst shape (thousands of rollouts forking one
+    # prompt at once), and it is the queued-up prefill BACKLOG that
+    # caching removes from TTFT. A finite rate lets arrivals outpace
+    # the queue on an idle box and the contrast collapses to a single
+    # prefill — the measurement then flips between queued and
+    # unqueued regimes run to run.
+    rate = float("inf")
+
+    def prompts_for(sample):
+        r = np.random.RandomState(500 + sample)
+        return [prefix + r.randint(1, 500, size=suffix_len).tolist()
+                for _ in range(n)]
+
+    results = {}
+    for label, overrides in (
+            ("cold", {"enable_prefix_cache": False}),
+            ("warm", {"enable_prefix_cache": True})):
+        eng, stop = _mk_engine(args, max_model_len=ctx_len,
+                               model_config=model_cfg, **overrides)
+        if label == "warm":  # prime the prefix once, outside measurement
+            eng.generate(prefix + [7] * suffix_len,
+                         SamplingParams(max_tokens=1), timeout=300)
+
+        def sample(i, eng=eng):
+            return _drive_open_loop(eng, prompts_for(i), sp, rate,
+                                    seed=i)
+
+        results[label] = _recipe(sample, samples=args.samples,
+                                 control_key="ttft_p50_ms")
+        st = eng.stats()
+        results[label].update({
+            "prefix_hit_pages": st["prefix_hit_pages"],
+            "prefix_evictions": st["prefix_evictions"],
+        })
+        stop.set()
+    warm, cold = results["warm"], results["cold"]
+    speedup = cold["ttft_p50_ms"] / warm["ttft_p50_ms"] \
+        if warm["ttft_p50_ms"] else float("nan")
+    return {"cold": cold, "warm": warm,
+            "prefix_tokens": prefix_len,
+            "ttft_p50_speedup": round(speedup, 2)}
 
 
 def bench_serve_deployment(args) -> dict:
@@ -176,7 +342,10 @@ def main():
     ap.add_argument("--max-model-len", type=int, default=128)
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--num-blocks", type=int, default=None)
+    ap.add_argument("--samples", type=int, default=7,
+                    help="samples per attempt (round-5 recipe)")
     ap.add_argument("--serve", action="store_true")
+    ap.add_argument("--skip-shared-prefix", action="store_true")
     ap.add_argument("--trace", default=None,
                     help="also dump a chrome trace to this file "
                          "(merged cluster timeline in --serve mode)")
@@ -184,16 +353,30 @@ def main():
 
     extra = bench_serve_deployment(args) if args.serve \
         else bench_engine(args)
+    secondary = [
+        {"metric": "serve_llm_ttft_p50", "unit": "ms",
+         "value": round(extra["ttft_p50_ms"], 1)},
+        {"metric": "serve_llm_ttft_p99", "unit": "ms",
+         "value": round(extra["ttft_p99_ms"], 1)},
+    ]
+    if not args.serve and not args.skip_shared_prefix:
+        shared = bench_shared_prefix(args)
+        extra["shared_prefix"] = shared
+        secondary += [
+            {"metric": "serve_llm_shared_prefix_ttft_p50_cold",
+             "unit": "ms",
+             "value": round(shared["cold"]["ttft_p50_ms"], 1)},
+            {"metric": "serve_llm_shared_prefix_ttft_p50_warm",
+             "unit": "ms",
+             "value": round(shared["warm"]["ttft_p50_ms"], 1)},
+            {"metric": "serve_llm_shared_prefix_ttft_speedup",
+             "unit": "x", "value": shared["ttft_p50_speedup"]},
+        ]
     out = {
         "metric": "serve_llm_tokens_per_sec",
         "value": round(extra["tokens_per_sec"], 1),
         "unit": "tokens/s",
-        "secondary_metrics": [
-            {"metric": "serve_llm_ttft_p50", "unit": "ms",
-             "value": round(extra["ttft_p50_ms"], 1)},
-            {"metric": "serve_llm_ttft_p99", "unit": "ms",
-             "value": round(extra["ttft_p99_ms"], 1)},
-        ],
+        "secondary_metrics": secondary,
         "extra": extra,
     }
     print(json.dumps(out))

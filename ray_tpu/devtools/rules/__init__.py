@@ -10,6 +10,7 @@ from ray_tpu.devtools.rules import (  # noqa: F401
     except_hygiene,
     guarded_by,
     host_transfer,
+    lock_order,
     oneway_return,
     spmd_nondeterminism,
     store_refcount,

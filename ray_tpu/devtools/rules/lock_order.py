@@ -1,0 +1,97 @@
+"""GL009: lock-order inversion between nested ``with <lock>:`` blocks.
+
+The engine/scheduler/cache stack (and the nodelet before it) layers
+locks: an outer coordination lock (``self._lock``) wrapping calls into
+a store/pool whose own lock is a LEAF. That layering only stays
+deadlock-free while every code path acquires the locks in one global
+order — the moment one function nests ``A -> B`` and another nests
+``B -> A``, two threads can each hold one lock and wait forever on the
+other.
+
+This rule records every ordered pair of lock acquisitions that appear
+lexically nested (``with A: ... with B:``), scoped per class (plain
+``self._lock`` names in different classes are different locks), and
+fires when both orders of the same pair show up. The later-seen
+direction is reported at each of its acquisition sites, naming the
+function holding the first direction — both sides of an inversion are
+equally "wrong"; the report just needs a deterministic anchor.
+
+Only attribute chains whose last component mentions ``lock`` (e.g.
+``self._lock``, ``self.store._store_lock``, ``pool._lock``) are
+considered: `with` is also files/meshes/spans, and a lint that
+second-guesses every context manager would drown the real signal.
+Cross-function and cross-class inversions (A held while *calling* a
+method that takes B) are out of scope — interprocedural analysis costs
+more than the convention it protects; document leaf locks instead,
+like cache.BlockPool does.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools.context import ModuleContext, qualname
+from ray_tpu.devtools.registry import Rule, register
+
+
+def _is_lock_name(qn: str) -> bool:
+    return "lock" in qn.rsplit(".", 1)[-1].lower()
+
+
+@register
+class LockOrderRule(Rule):
+    name = "lock-order"
+    code = "GL009"
+    description = ("nested with-lock acquisitions in inverted orders "
+                   "(A->B in one function, B->A in another)")
+    invariant = ("every code path acquires any pair of locks in one "
+                 "global order, so no two threads can deadlock "
+                 "holding one each")
+    interests = ("With", "AsyncWith")
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        # (scope, outer, inner) -> [(node, function name), ...]
+        self._orders: dict[tuple[str, str, str], list] = {}
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        locks = [qn for qn in (qualname(i.context_expr)
+                               for i in node.items)
+                 if qn is not None and _is_lock_name(qn)]
+        if not locks:
+            return
+        held = [qn for qn in ctx.lock_stack if _is_lock_name(qn)]
+        if not held:
+            return
+        scope = ctx.current_class.name if ctx.current_class else ""
+        fn = ctx.current_function.name if ctx.current_function else "?"
+        for outer in held:
+            for inner in locks:
+                if inner == outer:
+                    continue
+                self._orders.setdefault(
+                    (scope, outer, inner), []).append((node, fn))
+
+    def end_module(self, ctx: ModuleContext) -> None:
+        reported: set[int] = set()
+        for (scope, outer, inner), sites in sorted(
+                self._orders.items(),
+                key=lambda kv: min(s[0].lineno for s in kv[1])):
+            rev = self._orders.get((scope, inner, outer))
+            if not rev:
+                continue
+            # report the direction whose first acquisition appears
+            # later in the file; the earlier one defines "the" order
+            first = min(s[0].lineno for s in sites)
+            rev_first = min(s[0].lineno for s in rev)
+            if first < rev_first:
+                continue  # the reverse entry will report
+            holder = rev[0][1]
+            for site, fn in sites:
+                if id(site) in reported:
+                    continue
+                reported.add(id(site))
+                ctx.report(
+                    self, site,
+                    f"lock-order inversion: {fn} acquires {inner} "
+                    f"while holding {outer}, but {holder} acquires "
+                    f"them as {inner} -> {outer}")

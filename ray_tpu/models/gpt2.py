@@ -290,6 +290,92 @@ def gpt2_prefill_kv(
     return logits.astype(jnp.float32), k, v
 
 
+def _chunk_block(x, p, k_ctx, v_ctx, ctx_mask, chunk_mask, cfg: GPT2Config):
+    """Chunked-prefill block step. x (B, T, E) holds a CHUNK of the
+    sequence at absolute positions start..start+T-1; k_ctx/v_ctx
+    (B, C, H, D) hold the already-cached context for positions < start
+    (ctx_mask (B, C) marks valid slots); chunk_mask (B, T) marks real
+    (non-padded) chunk positions. Attention is context + causal within
+    the chunk. Returns (x, (k, v)) with k/v (B, T, H, D) — the chunk's
+    cache contribution."""
+    B, T, E = x.shape
+    dt = cfg.dtype
+    H, D = cfg.n_head, cfg.head_dim
+    h = _layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"])
+    qkv = h @ p["attn_qkv"]["kernel"].astype(dt) + p["attn_qkv"]["bias"].astype(dt)
+    qkv = constrain(qkv, ("data", "fsdp"), None, "tensor")
+    q, k, v = (t.reshape(B, T, H, D) for t in jnp.split(qkv, 3, axis=-1))
+
+    scale = 1.0 / (D**0.5)
+    s_ctx = jnp.einsum("bthd,bchd->bhtc", q, k_ctx).astype(jnp.float32)
+    s_own = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
+    s = jnp.concatenate([s_ctx, s_own], axis=-1) * scale
+    causal = jnp.tril(jnp.ones((T, T), dtype=bool))
+    valid = jnp.concatenate(
+        [jnp.broadcast_to(ctx_mask[:, None, :], (B, T, ctx_mask.shape[1])),
+         causal[None] & chunk_mask[:, None, :]], axis=-1)
+    s = jnp.where(valid[:, None, :, :], s, -1e30)
+    probs = jax.nn.softmax(s, axis=-1).astype(dt)
+    C = k_ctx.shape[1]
+    att = jnp.einsum("bhtc,bchd->bthd", probs[..., :C], v_ctx) \
+        + jnp.einsum("bhts,bshd->bthd", probs[..., C:], v)
+    att = att.reshape(B, T, E)
+    att = att @ p["attn_proj"]["kernel"].astype(dt) + p["attn_proj"]["bias"].astype(dt)
+    x = x + constrain(att, ("data", "fsdp"), None, None)
+
+    h = _layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+    h = h @ p["mlp_fc"]["kernel"].astype(dt) + p["mlp_fc"]["bias"].astype(dt)
+    h = constrain(h, ("data", "fsdp"), None, "tensor")
+    h = jax.nn.gelu(h)
+    h = h @ p["mlp_proj"]["kernel"].astype(dt) + p["mlp_proj"]["bias"].astype(dt)
+    x = x + constrain(h, ("data", "fsdp"), None, None)
+    return x, (k, v)
+
+
+def gpt2_prefill_chunk_kv(
+    params: Params,
+    tokens: jax.Array,
+    start: jax.Array,
+    k_ctx: jax.Array,
+    v_ctx: jax.Array,
+    ctx_mask: jax.Array,
+    chunk_mask: jax.Array,
+    cfg: GPT2Config,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Prefill a CHUNK of one or more sequences from a position offset
+    (chunked / incremental prefill).
+
+    tokens (B, T) sit at absolute positions start..start+T-1 (start is
+    a traced scalar, so one compiled program serves every offset);
+    k_ctx/v_ctx (L, B, C, H, D) hold gathered cached context for
+    positions < start, ctx_mask (B, C) marks its valid slots and
+    chunk_mask (B, T) the chunk's real tokens. Returns
+    (logits (B, T, Vp) f32, k, v (L, B, T, H, D)) — the caller scatters
+    k/v into the paged cache at the chunk's positions.
+    """
+    B, T = tokens.shape
+    dt = cfg.dtype
+    wte = constrain(params["wte"].astype(dt), None, None)
+    # gather wpe by absolute position, NOT dynamic_slice: a slice clamps
+    # its start when start+T overruns the table (bucket padding can push
+    # past n_positions) and would silently shift every real token's
+    # positional embedding. Only padded tail rows ever clip here, and
+    # their K/V lands in the null page.
+    pos = jnp.clip(start + jnp.arange(T), 0, cfg.block_size - 1)
+    x = wte[tokens] + params["wpe"].astype(dt)[pos]
+    x = constrain(x, ("data", "fsdp"), None, None)
+
+    def body(carry, xs):
+        p, kc, vc = xs
+        return _chunk_block(carry, p, kc, vc, ctx_mask, chunk_mask, cfg)
+
+    x, (k, v) = jax.lax.scan(body, x, (params["blocks"], k_ctx, v_ctx))
+    x = _layer_norm(x, params["lnf"]["scale"], params["lnf"]["bias"])
+    logits = x @ params["wte"].astype(dt).T
+    logits = constrain(logits, ("data", "fsdp"), None, "tensor")
+    return logits.astype(jnp.float32), k, v
+
+
 def _decode_block(x, p, k_ctx, v_ctx, ctx_mask, cfg: GPT2Config):
     """Single-token block step. x (B, E); k_ctx/v_ctx (B, C, H, D) hold
     the sequence's cached context (padded; ctx_mask (B, C) marks valid

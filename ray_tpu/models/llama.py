@@ -233,6 +233,105 @@ def llama_prefill_kv(
     return logits.astype(jnp.float32), k, v
 
 
+def _rope_chunk(x, start, theta: float):
+    """Rotary embedding for a chunk at absolute positions
+    start..start+T-1 (start traced): x (B, T, H, D). Same formula as
+    `_rope`/`_rope_at`, so chunked K agrees bit-for-bit per position."""
+    B, T, H, D = x.shape
+    half = D // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    pos = (start + jnp.arange(T)).astype(jnp.float32)
+    angles = pos[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+def _chunk_block(x, p, k_ctx, v_ctx, ctx_mask, chunk_mask, start,
+                 cfg: LlamaConfig):
+    """Chunked-prefill block step; see models/gpt2.py `_chunk_block`.
+    x (B, T, E) at absolute positions start..start+T-1; k_ctx/v_ctx
+    (B, C, Hkv, D) post-rope cached context. Returns (x, (k, v)) with
+    k/v (B, T, Hkv, D) post-rope, pre-GQA-replication — the cached
+    layout."""
+    B, T, E = x.shape
+    dt = cfg.dtype
+    hd = cfg.head_dim
+    H, HK = cfg.n_head, cfg.n_kv_head
+
+    h = _rmsnorm(x, p["ln_attn"], cfg.rms_eps)
+    q = (h @ p["wq"].astype(dt)).reshape(B, T, H, hd)
+    k = (h @ p["wk"].astype(dt)).reshape(B, T, HK, hd)
+    v = (h @ p["wv"].astype(dt)).reshape(B, T, HK, hd)
+    q = _rope_chunk(q, start, cfg.rope_theta)
+    k = _rope_chunk(k, start, cfg.rope_theta)
+    k_cache, v_cache = k, v
+
+    rep = H // HK
+    kce = jnp.repeat(k_ctx, rep, axis=2)
+    vce = jnp.repeat(v_ctx, rep, axis=2)
+    ke = jnp.repeat(k, rep, axis=2)
+    ve = jnp.repeat(v, rep, axis=2)
+
+    scale = 1.0 / (hd**0.5)
+    s_ctx = jnp.einsum("bthd,bchd->bhtc", q, kce).astype(jnp.float32)
+    s_own = jnp.einsum("bthd,bshd->bhts", q, ke).astype(jnp.float32)
+    s = jnp.concatenate([s_ctx, s_own], axis=-1) * scale
+    causal = jnp.tril(jnp.ones((T, T), dtype=bool))
+    valid = jnp.concatenate(
+        [jnp.broadcast_to(ctx_mask[:, None, :], (B, T, ctx_mask.shape[1])),
+         causal[None] & chunk_mask[:, None, :]], axis=-1)
+    s = jnp.where(valid[:, None, :, :], s, -1e30)
+    probs = jax.nn.softmax(s, axis=-1).astype(dt)
+    C = k_ctx.shape[1]
+    att = jnp.einsum("bhtc,bchd->bthd", probs[..., :C], vce) \
+        + jnp.einsum("bhts,bshd->bthd", probs[..., C:], ve)
+    att = att.reshape(B, T, E) @ p["wo"].astype(dt)
+    x = x + constrain(att, ("data", "fsdp"), None, None)
+
+    h = _rmsnorm(x, p["ln_mlp"], cfg.rms_eps)
+    gate = h @ p["w_gate"].astype(dt)
+    up = h @ p["w_up"].astype(dt)
+    gate = constrain(gate, ("data", "fsdp"), None, "tensor")
+    x = x + constrain(
+        (jax.nn.silu(gate) * up) @ p["w_down"].astype(dt),
+        ("data", "fsdp"), None, None)
+    return x, (k_cache, v_cache)
+
+
+def llama_prefill_chunk_kv(
+    params: Params,
+    tokens: jax.Array,
+    start: jax.Array,
+    k_ctx: jax.Array,
+    v_ctx: jax.Array,
+    ctx_mask: jax.Array,
+    chunk_mask: jax.Array,
+    cfg: LlamaConfig,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Chunked prefill from a position offset; see gpt2_prefill_chunk_kv.
+    k_ctx/v_ctx are (L, B, C, Hkv, D); returns (logits (B, T, Vp) f32,
+    k, v (L, B, T, Hkv, D))."""
+    dt = cfg.dtype
+    wte = constrain(params["wte"].astype(dt), None, None)
+    x = wte[tokens]
+    x = constrain(x, ("data", "fsdp"), None, None)
+
+    def body(carry, xs):
+        p, kc, vc = xs
+        return _chunk_block(carry, p, kc, vc, ctx_mask, chunk_mask,
+                            start, cfg)
+
+    x, (k, v) = jax.lax.scan(body, x, (params["blocks"], k_ctx, v_ctx))
+    x = _rmsnorm(x, params["lnf"], cfg.rms_eps)
+    logits = x @ params["wte"].astype(dt).T
+    logits = constrain(logits, ("data", "fsdp"), None, "tensor")
+    return logits.astype(jnp.float32), k, v
+
+
 def _decode_block(x, p, k_ctx, v_ctx, ctx_mask, positions, cfg: LlamaConfig):
     """Single-token block step; x (B, E), k_ctx/v_ctx (B, C, Hkv, D)
     post-rope cached context, ctx_mask (B, C), positions (B,).

@@ -115,6 +115,12 @@ class Deployment:
     ray_actor_options: dict | None = None
     max_ongoing_requests: int = 16
     autoscaling_config: AutoscalingConfig | None = None
+    # opt-in: the serve proxy derives a prefix-affinity routing key from
+    # this app's payloads ({"prompt": [token ids]} — see
+    # payload_affinity_key). Off by default: a non-LLM app whose payload
+    # merely resembles one must keep power-of-two load routing instead
+    # of getting rendezvous-pinned to a single replica.
+    payload_affinity: bool = False
 
     def __post_init__(self):
         # options(autoscaling_config={...}) goes through replace() and
@@ -153,13 +159,15 @@ class _HandleRef:
 def deployment(_cls=None, *, name: str | None = None, num_replicas: int = 1,
                ray_actor_options: dict | None = None,
                max_ongoing_requests: int = 16,
-               autoscaling_config: AutoscalingConfig | dict | None = None):
+               autoscaling_config: AutoscalingConfig | dict | None = None,
+               payload_affinity: bool = False):
     def wrap(cls):
         return Deployment(cls, name or cls.__name__,
                           num_replicas=num_replicas,
                           ray_actor_options=ray_actor_options,
                           max_ongoing_requests=max_ongoing_requests,
-                          autoscaling_config=autoscaling_config)
+                          autoscaling_config=autoscaling_config,
+                          payload_affinity=payload_affinity)
 
     return wrap(_cls) if _cls is not None else wrap
 
@@ -228,6 +236,36 @@ class _Replica:
         return "pong"
 
 
+def _wait_replicas_ready(replicas, timeout: float = 180.0) -> None:
+    """Readiness barrier that outlives the runtime's internal actor-
+    resolution window: a replica still CONSTRUCTING (heavy __init__ —
+    an LLM replica compiles every bucketed program during warmup, ~1
+    min for several replicas on a small box) surfaces as
+    ActorUnavailableError from a 60s resolve cap, which is 'not yet',
+    not 'failed'. Retry pings until this barrier's own deadline; real
+    deaths (ActorDiedError) propagate immediately."""
+    import time as _t
+
+    import ray_tpu
+    from ray_tpu.core import exceptions as exc
+
+    deadline = _t.monotonic() + timeout
+    for r in replicas:
+        while True:
+            budget = deadline - _t.monotonic()
+            if budget <= 0:
+                raise exc.ActorUnavailableError(
+                    f"replica not ready within {timeout}s")
+            try:
+                ray_tpu.get(r.ping.remote(), timeout=min(30.0, budget))
+                break
+            except (exc.ActorUnavailableError, exc.GetTimeoutError):
+                # GetTimeoutError is the local runtime's "still
+                # constructing" shape: the ping queues behind a heavy
+                # __init__ in the actor thread instead of erroring
+                _t.sleep(1.0)
+
+
 class ServeController:
     """Controller actor: owns the deployment -> replica-handles table and
     reconciles replica counts, including load-driven autoscaling
@@ -268,7 +306,8 @@ class ServeController:
 
     def deploy(self, app_name: str, cls_blob: bytes, num_replicas: int,
                actor_options: dict | None, init_args, init_kwargs,
-               max_concurrency: int, autoscaling: dict | None = None):
+               max_concurrency: int, autoscaling: dict | None = None,
+               payload_affinity: bool = False):
         import ray_tpu
 
         # version must be monotonic ACROSS redeploys or handles holding
@@ -280,14 +319,15 @@ class ServeController:
                "init_args": init_args, "init_kwargs": init_kwargs,
                "max_concurrency": max_concurrency,
                "autoscaling": autoscaling, "idle_rounds": 0,
-               "version": next_version}
+               "version": next_version,
+               "payload_affinity": payload_affinity}
         if autoscaling:
             num_replicas = max(autoscaling["min_replicas"],
                                min(num_replicas,
                                    autoscaling["max_replicas"]))
         replicas = [self._make_replica(app) for _ in range(num_replicas)]
         # readiness barrier: every replica constructed
-        ray_tpu.get([r.ping.remote() for r in replicas], timeout=120)
+        _wait_replicas_ready(replicas, timeout=180)
         app["replicas"] = replicas
         app["num_replicas"] = num_replicas
         self._apps[app_name] = app
@@ -323,7 +363,7 @@ class ServeController:
                         len(replicas) < cfg["max_replicas"]:
                     new = self._make_replica(app)
                     try:
-                        ray_tpu.get(new.ping.remote(), timeout=60)
+                        _wait_replicas_ready([new], timeout=120)
                         replicas.append(new)
                         app["num_replicas"] = len(replicas)
                         app["version"] += 1
@@ -381,7 +421,8 @@ class ServeController:
         if not app:
             return {"replicas": [], "version": -1}
         return {"replicas": list(app["replicas"]),
-                "version": app.get("version", 0)}
+                "version": app.get("version", 0),
+                "payload_affinity": app.get("payload_affinity", False)}
 
     def list_apps(self):
         return {k: v["num_replicas"] for k, v in self._apps.items()}
@@ -418,21 +459,42 @@ def _traced_submit(span_name: str, submit):
         return submit()
 
 
+def _replica_ident(replica) -> str:
+    """Stable identity for rendezvous hashing: the actor id survives
+    handle re-fetches, so a given affinity key keeps landing on the
+    same replica until the replica set itself changes."""
+    aid = getattr(replica, "_actor_id", None)
+    try:
+        return aid.hex()
+    except Exception:  # noqa: BLE001
+        return repr(replica)
+
+
 class DeploymentHandle:
     """Client-side router (reference: DeploymentHandle + the
     power-of-two-choices replica scheduler, _private/router.py:318 —
     here: sample two replicas, pick the one with fewer ongoing
-    requests; falls back to round-robin when probing fails). The replica
-    list is PUSHED via the head's long-poll pubsub (reference:
-    serve/_private/long_poll.py) — the periodic poll below is only an
-    anti-entropy fallback against lost pushes."""
+    requests; falls back to round-robin when probing fails). With an
+    `affinity_key` (e.g. an LLM prompt-prefix hash), routing switches
+    to rendezvous hashing — the key's highest-scoring replica wins, so
+    equal keys reuse one replica's warm state — with a load-based
+    fallback to the key's second choice when the primary is saturated.
+    The replica list is PUSHED via the head's long-poll pubsub
+    (reference: serve/_private/long_poll.py) — the periodic poll below
+    is only an anti-entropy fallback against lost pushes."""
 
     _REFRESH_S = 5.0  # fallback only; pushes arrive in <100ms. Also the
     # worst-case staleness bound _drain_and_kill waits out before killing
+    # affinity fallback: spill to the second rendezvous choice only when
+    # the primary holds this many MORE ongoing requests than it — small
+    # enough to shed hotspots, large enough that routing stays sticky
+    _AFFINITY_SLACK = 4
 
-    def __init__(self, app_name: str, replicas: list):
+    def __init__(self, app_name: str, replicas: list,
+                 payload_affinity: bool = False):
         self.app_name = app_name
         self._replicas = replicas
+        self._payload_affinity = payload_affinity
         self._rr = 0
         self._version = 0
         self._lock = threading.Lock()
@@ -456,6 +518,8 @@ class DeploymentHandle:
                 with self._lock:
                     self._replicas = r["replicas"]
                     self._version = r["version"]
+                    self._payload_affinity = r.get(
+                        "payload_affinity", self._payload_affinity)
         except Exception as e:  # noqa: BLE001
             # do NOT swallow silently (VERDICT r3 weak 8): a stale routing
             # set sends traffic to drained replicas
@@ -470,7 +534,7 @@ class DeploymentHandle:
             return
         self._refresh_now()
 
-    def _pick(self):
+    def _pick(self, affinity_key: str | None = None):
         import random
 
         import ray_tpu
@@ -478,6 +542,8 @@ class DeploymentHandle:
         self._maybe_refresh()
         if len(self._replicas) == 1:
             return self._replicas[0]
+        if affinity_key is not None:
+            return self._pick_affinity(affinity_key)
         a, b = random.sample(self._replicas, 2)
         try:
             qa, qb = ray_tpu.get(
@@ -489,6 +555,42 @@ class DeploymentHandle:
             with self._lock:
                 self._rr = (self._rr + 1) % len(self._replicas)
                 return self._replicas[self._rr]
+
+    def _pick_affinity(self, key: str):
+        """Rendezvous (highest-random-weight) choice: every handle
+        ranks replicas identically for a given key, so requests sharing
+        a prompt prefix converge on one replica's warm KV cache, and a
+        replica-set change only remaps the keys that hashed to the
+        departed replica. Load fallback: if the primary is carrying
+        _AFFINITY_SLACK more ongoing requests than the key's second
+        choice, spill to the second — still deterministic per key, so
+        the spilled traffic warms ONE backup replica, not a random
+        one."""
+        import hashlib
+
+        import ray_tpu
+
+        def score(r):
+            return hashlib.blake2b(
+                f"{key}:{_replica_ident(r)}".encode(),
+                digest_size=8).digest()
+
+        with self._lock:
+            replicas = list(self._replicas)
+        if len(replicas) < 2:  # set shrank since _pick's check
+            return replicas[0]
+        ranked = sorted(replicas, key=score, reverse=True)
+        primary, second = ranked[0], ranked[1]
+        try:
+            qp, qs = ray_tpu.get(
+                [primary.ongoing.options(
+                    concurrency_group="control").remote(),
+                 second.ongoing.options(
+                     concurrency_group="control").remote()],
+                timeout=5)
+            return primary if qp <= qs + self._AFFINITY_SLACK else second
+        except Exception:  # noqa: BLE001
+            return primary  # probe failed: stay sticky
 
     def remote(self, *args, **kwargs):
         return _traced_submit(
@@ -505,8 +607,16 @@ class DeploymentHandle:
 
         return call
 
+    def affinity_key_for(self, payload) -> str | None:
+        """Routing key the proxy should use for `payload` — None unless
+        this app opted in via Deployment(payload_affinity=True)."""
+        if not self._payload_affinity:
+            return None
+        return payload_affinity_key(payload)
+
     def options(self, *, stream: bool = False,
-                generator_backpressure: int | None = None
+                generator_backpressure: int | None = None,
+                affinity_key: str | None = None
                 ) -> "DeploymentHandle":
         """stream=True: calls return an ObjectRefGenerator — one ref per
         chunk the deployment yields, delivered as produced (reference:
@@ -514,20 +624,40 @@ class DeploymentHandle:
         `generator_backpressure` caps yielded-but-unconsumed chunks
         before the replica blocks — a slow stream consumer (an LLM
         client reading tokens at human speed) must not buffer an
-        unbounded queue on the replica."""
-        if not stream:
+        unbounded queue on the replica. `affinity_key` switches replica
+        choice to rendezvous hashing on the key (see _pick_affinity) —
+        per-call state, so pass it per request:
+        ``handle.options(stream=True, affinity_key=k).remote(...)``."""
+        if not stream and affinity_key is None:
             return self
-        return _StreamingHandle(self, generator_backpressure)
+        return _StreamingHandle(self, generator_backpressure,
+                                affinity_key=affinity_key, stream=stream)
 
 
 class _StreamingHandle:
-    """View over a DeploymentHandle whose calls ride the streaming
-    generator protocol (chunks consumable before the handler returns)."""
+    """View over a DeploymentHandle carrying per-call options: streaming
+    generator protocol (chunks consumable before the handler returns)
+    and/or an affinity routing key."""
 
     def __init__(self, base: DeploymentHandle,
-                 backpressure: int | None = None):
+                 backpressure: int | None = None, *,
+                 affinity_key: str | None = None, stream: bool = True):
         self._base = base
         self._backpressure = backpressure
+        self._affinity_key = affinity_key
+        self._stream = stream
+
+    def options(self, *, stream: bool | None = None,
+                generator_backpressure: int | None = None,
+                affinity_key: str | None = None) -> "_StreamingHandle":
+        """Layer more per-call options on (unset fields inherit)."""
+        return _StreamingHandle(
+            self._base,
+            (self._backpressure if generator_backpressure is None
+             else generator_backpressure),
+            affinity_key=(affinity_key if affinity_key is not None
+                          else self._affinity_key),
+            stream=self._stream if stream is None else stream)
 
     def _opts(self):
         o = {"num_returns": "streaming"}
@@ -535,20 +665,48 @@ class _StreamingHandle:
             o["generator_backpressure_num_objects"] = self._backpressure
         return o
 
+    def _submit(self, method_name: str, args, kwargs):
+        replica = self._base._pick(self._affinity_key)
+        if self._stream:
+            return replica.handle_stream_request.options(
+                **self._opts()).remote(method_name, args, kwargs)
+        return replica.handle_request.remote(method_name, args, kwargs)
+
     def remote(self, *args, **kwargs):
         return _traced_submit(
             f"serve.{self._base.app_name}",
-            lambda: self._base._pick().handle_stream_request.options(
-                **self._opts()).remote("__call__", args, kwargs))
+            lambda: self._submit("__call__", args, kwargs))
 
     def method(self, name: str):
         def call(*args, **kwargs):
             return _traced_submit(
                 f"serve.{self._base.app_name}.{name}",
-                lambda: self._base._pick().handle_stream_request.options(
-                    **self._opts()).remote(name, args, kwargs))
+                lambda: self._submit(name, args, kwargs))
 
         return call
+
+
+def payload_affinity_key(payload) -> str | None:
+    """Routing key for LLM-style payloads (``{"prompt": [token ids]}``):
+    requests sharing a prompt prefix rendezvous onto one replica, whose
+    KV prefix cache then serves the shared prefix without re-prefill.
+    The proxy only applies this to apps that opted in via
+    ``Deployment(payload_affinity=True)`` (see
+    ``DeploymentHandle.affinity_key_for``) — a non-LLM payload that
+    merely looks like a prompt must not lose load balancing.
+    Returns None for anything that doesn't look like one — callers fall
+    back to load-based routing."""
+    if not isinstance(payload, dict):
+        return None
+    prompt = payload.get("prompt")
+    if not isinstance(prompt, (list, tuple)) or not prompt:
+        return None
+    try:
+        from ray_tpu.serve.llm.deployment import prompt_affinity_key
+
+        return prompt_affinity_key(prompt)
+    except Exception:  # noqa: BLE001
+        return None
 
 
 def _controller():
@@ -589,7 +747,7 @@ def run(app: Application, *, name: str = "default",
         ray_tpu.get(ctrl.deploy.remote(
             app_name, blob, dep.num_replicas, dep.ray_actor_options,
             init_args, init_kwargs, dep.max_ongoing_requests,
-            autoscaling),
+            autoscaling, dep.payload_affinity),
             timeout=180)
 
     deploy_graph(app, name)
@@ -606,7 +764,9 @@ def get_app_handle(name: str = "default") -> DeploymentHandle:
     r = ray_tpu.get(ctrl.get_replicas.remote(name), timeout=60)
     if not r["replicas"]:
         raise ValueError(f"no serve application named {name!r}")
-    return DeploymentHandle(name, r["replicas"])
+    return DeploymentHandle(name, r["replicas"],
+                            payload_affinity=r.get("payload_affinity",
+                                                   False))
 
 
 def delete(name: str = "default"):
@@ -705,7 +865,10 @@ class ProxyActor:
                         with proxy._stats_lock:
                             proxy._totals["streamed"] += 1
                     else:
-                        ref = proxy._handle(app).remote(payload)
+                        h = proxy._handle(app)
+                        ref = h.options(
+                            affinity_key=h.affinity_key_for(payload)
+                        ).remote(payload)
                         result = ray_tpu.get(ref, timeout=120)
                         self._reply(200, {"result": result})
                 except Exception as e:  # noqa: BLE001
@@ -736,8 +899,11 @@ class ProxyActor:
                 after headers are out they become a terminal error line
                 — a second response on a chunked connection would
                 corrupt the protocol."""
-                gen = proxy._handle(app).options(stream=True).remote(
-                    payload)
+                h = proxy._handle(app)
+                gen = h.options(
+                    stream=True,
+                    affinity_key=h.affinity_key_for(payload),
+                ).remote(payload)
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
                 self.send_header("Transfer-Encoding", "chunked")
@@ -831,7 +997,10 @@ class ProxyActor:
             status = "OK"
             try:
                 payload = json.loads(request) if request else None
-                ref = proxy._handle(app).remote(payload)
+                h = proxy._handle(app)
+                ref = h.options(
+                    affinity_key=h.affinity_key_for(payload)
+                ).remote(payload)
                 result = ray_tpu.get(ref, timeout=120)
                 return json.dumps({"result": result},
                                   default=str).encode()
@@ -857,8 +1026,11 @@ class ProxyActor:
                 proxy._totals["streamed"] += 1
             try:
                 payload = json.loads(request) if request else None
-                gen = proxy._handle(app).options(stream=True).remote(
-                    payload)
+                h = proxy._handle(app)
+                gen = h.options(
+                    stream=True,
+                    affinity_key=h.affinity_key_for(payload),
+                ).remote(payload)
                 for ref in gen:
                     item = ray_tpu.get(ref, timeout=120)
                     yield json.dumps({"result": item},

@@ -3,18 +3,22 @@
 The serving counterpart of ray.serve's LLM stack, built jax-first:
 
 - a **block KV-cache pool** (`cache.py`): fixed-size pages over one
-  device array per model, a free-list allocator, and per-sequence block
-  tables — page 0 is a reserved null sink so padded lanes always have a
-  legal scatter/gather target;
-- jit-compiled **prefill and single-token decode** steps (`runner.py`)
-  for the gpt2 and llama model families, with length-bucketed padding
-  so the number of compiled programs stays bounded, sharded through the
-  models' own `parallel/sharding.py` partition rules when a mesh is
-  given;
-- a **continuous-batching scheduler** (`scheduler.py`): admission
-  queue, prefill/decode interleaving, recompute-style preemption +
-  requeue when the cache pool is exhausted, EOS / max-tokens
-  completion;
+  device array per model, refcounted and content-addressed — identical
+  prompt prefixes share physical pages (automatic prefix caching), and
+  released pages park in an LRU instead of being zapped, so a repeat
+  prompt revives them; page 0 is a reserved null sink so padded lanes
+  always have a legal scatter/gather target;
+- jit-compiled **prefill, chunked prefill-from-offset, and single-token
+  decode** steps (`runner.py`) for the gpt2 and llama model families,
+  with length-bucketed padding so the number of compiled programs stays
+  bounded, in-jit greedy / temperature / top-k / top-p sampling,
+  sharded through the models' own `parallel/sharding.py` partition
+  rules when a mesh is given;
+- a **continuous-batching scheduler** (`scheduler.py`): admission with
+  longest-prefix match, chunked prefill interleaved with decode steps
+  (a long prompt stalls the decode batch by one chunk, not one prompt),
+  recompute-style preemption + requeue when the cache pool is
+  exhausted, EOS / max-tokens completion;
 - an **engine** (`engine.py`) gluing the three together, streaming
   tokens per request and exporting serving metrics (tokens/s, TTFT,
   queue depth, cache utilization) through `ray_tpu.util.metrics`;
@@ -27,7 +31,11 @@ See SERVING.md for the architecture walkthrough.
 
 from ray_tpu.serve.llm.cache import BlockPool
 from ray_tpu.serve.llm.config import EngineConfig, SamplingParams
-from ray_tpu.serve.llm.deployment import LLMServer, build_llm_app
+from ray_tpu.serve.llm.deployment import (
+    LLMServer,
+    build_llm_app,
+    prompt_affinity_key,
+)
 from ray_tpu.serve.llm.engine import LLMEngine, RequestStream
 from ray_tpu.serve.llm.runner import ModelRunner
 from ray_tpu.serve.llm.scheduler import Scheduler, Sequence, SeqState
@@ -44,4 +52,5 @@ __all__ = [
     "SeqState",
     "Sequence",
     "build_llm_app",
+    "prompt_affinity_key",
 ]

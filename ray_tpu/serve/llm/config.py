@@ -18,6 +18,8 @@ class SamplingParams:
 
     max_tokens: int = 16
     temperature: float = 0.0  # 0 => greedy argmax
+    top_k: int = 0  # 0 => disabled; else sample from the k best
+    top_p: float = 1.0  # 1.0 => disabled; else nucleus sampling
     eos_token_id: int | Sequence[int] | None = None
     # include prompt token ids in the final output event (debug aid)
     echo: bool = False
@@ -30,6 +32,10 @@ class SamplingParams:
         if self.temperature < 0:
             raise ValueError(f"temperature must be >= 0, got "
                              f"{self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+        if not 0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
 
     def eos_set(self) -> frozenset[int]:
         if self.eos_token_id is None:
@@ -44,6 +50,8 @@ class SamplingParams:
         return SamplingParams(
             max_tokens=int(d.get("max_tokens", 16)),
             temperature=float(d.get("temperature", 0.0)),
+            top_k=int(d.get("top_k", 0)),
+            top_p=float(d.get("top_p", 1.0)),
             eos_token_id=d.get("eos_token_id"),
             echo=bool(d.get("echo", False)))
 
@@ -63,6 +71,13 @@ class EngineConfig:
     max_model_len: int | None = None  # default: model cfg block_size
     max_batch_size: int = 8  # concurrent decode lanes
     prefill_bucket_min: int = 16
+    # chunked prefill: prompts longer than this prefill in page-aligned
+    # chunks interleaved with decode steps (0 disables — monolithic
+    # prefill only, no prefill-from-offset program)
+    prefill_chunk_size: int = 256
+    # content-addressed KV pages: identical prompt prefixes share
+    # physical pages and skip their prefill entirely
+    enable_prefix_cache: bool = True
     seed: int = 0  # weight init seed when no params are passed
 
     def __post_init__(self):
@@ -70,6 +85,8 @@ class EngineConfig:
             raise ValueError("block_size must be >= 1")
         if self.max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
+        if self.prefill_chunk_size < 0:
+            raise ValueError("prefill_chunk_size must be >= 0")
 
     @staticmethod
     def from_dict(d: dict) -> "EngineConfig":

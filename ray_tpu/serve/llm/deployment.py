@@ -22,9 +22,30 @@ don't queue behind long-running token streams.
 from __future__ import annotations
 
 import threading
-from typing import Any
+from typing import Any, Sequence
 
 from ray_tpu.serve.llm.config import EngineConfig, SamplingParams
+
+# prefix-affinity routing hashes only the prompt's HEAD: requests whose
+# prompts agree on their first AFFINITY_PREFIX_LEN tokens (a shared
+# system prompt, an RL rollout's common context) rendezvous onto the
+# same replica, whose prefix cache then serves them without re-prefill.
+# The window is deliberately short — it must cover the *shared* part of
+# typical prompts while ignoring their unique tails, and a shared head
+# of one page is already worth routing for
+AFFINITY_PREFIX_LEN = 16
+
+
+def prompt_affinity_key(prompt: Sequence[int],
+                        prefix_len: int = AFFINITY_PREFIX_LEN) -> str:
+    """Stable routing key for a token-id prompt: hash of its first
+    `prefix_len` tokens (the whole prompt when shorter). Same chain
+    hash the KV pool uses, so 'same key' == 'prefix the replica's cache
+    can actually reuse'."""
+    from ray_tpu.serve.llm.cache import hash_page
+
+    return format(hash_page(0, [int(t) for t in prompt[:prefix_len]]),
+                  "016x")
 
 
 class LLMServer:
@@ -115,5 +136,8 @@ def build_llm_app(
         num_replicas=num_replicas,
         max_ongoing_requests=max_ongoing_requests,
         ray_actor_options=ray_actor_options,
+        # the proxy routes {"prompt": [ids]} payloads by prompt-prefix
+        # hash so same-prefix requests land on one replica's warm cache
+        payload_affinity=True,
     )
     return dep.bind(cfg)

@@ -143,7 +143,12 @@ class LLMEngine:
                 f"({max_blocks_per_seq} blocks needed); raise num_blocks "
                 f"or lower max_model_len")
 
-        self.pool = BlockPool(num_blocks, config.block_size)
+        # prefix reuse needs the prefill-from-offset (chunk) program:
+        # with chunking disabled the pool runs as a plain allocator
+        chunking = config.prefill_chunk_size > 0
+        self.pool = BlockPool(
+            num_blocks, config.block_size,
+            enable_prefix_cache=(config.enable_prefix_cache and chunking))
         self.runner = ModelRunner(
             adapter, cfg, params,
             block_size=config.block_size,
@@ -151,12 +156,17 @@ class LLMEngine:
             max_model_len=max_len,
             max_batch_size=config.max_batch_size,
             prefill_bucket_min=config.prefill_bucket_min,
+            prefill_chunk_size=(config.prefill_chunk_size if chunking
+                                else None),
             mesh=mesh,
             sample_seed=config.seed + 1,
         )
         self.scheduler = Scheduler(
             self.pool, max_batch_size=config.max_batch_size,
-            max_model_len=max_len)
+            max_model_len=max_len,
+            # the runner rounds the chunk to a page-aligned size; reuse
+            # its value so scheduler chunks match the compiled buckets
+            chunk_size=(self.runner.prefill_chunk_size or 0))
 
         self._ids = itertools.count()
         self._streams: dict[int, RequestStream] = {}  # guarded_by(_lock)
@@ -201,6 +211,31 @@ class LLMEngine:
             "serve_llm_step_ms", "Engine step latency",
             boundaries=(1, 5, 10, 25, 50, 100, 250, 500, 1000),
             tag_keys=("model", "kind"))
+        self._m_prefix_hits = Counter(
+            "serve_llm_prefix_cache_hits_total",
+            "KV pages served from the prefix cache at admission",
+            tag_keys=tags)
+        self._m_prefix_misses = Counter(
+            "serve_llm_prefix_cache_misses_total",
+            "KV pages that had to be prefilled at admission",
+            tag_keys=tags)
+        self._m_prefix_evict = Counter(
+            "serve_llm_prefix_cache_evictions_total",
+            "Cached refcount-0 pages evicted for reuse", tag_keys=tags)
+        self._m_cached_blocks = Gauge(
+            "serve_llm_prefix_cached_blocks",
+            "Refcount-0 pages retained for prefix reuse", tag_keys=tags)
+        self._m_chunks = Counter(
+            "serve_llm_prefill_chunks_total",
+            "Prefill chunks executed", tag_keys=tags)
+        self._m_stall = Histogram(
+            "serve_llm_prefill_stall_ms",
+            "Decode stall imposed by a prefill step that ran while "
+            "decode-ready lanes were waiting",
+            boundaries=(1, 5, 10, 25, 50, 100, 250, 500, 1000),
+            tag_keys=tags)
+        # counter deltas are computed against the last pump
+        self._last_prefix = (0, 0, 0)
 
     def _note_tokens(self, n: int) -> None:
         self._m_tokens.inc(n, tags=self._m_tags)
@@ -281,8 +316,17 @@ class LLMEngine:
                 return retired != []
             t0 = time.perf_counter()
             if isinstance(work, PrefillWork):
-                self._do_prefill(work.seq)
+                with self._lock:
+                    # lanes this prefill step is holding back
+                    stalled = sum(
+                        1 for s in self.scheduler.running
+                        if s is not work.seq and not s.prefill_pending)
+                self._do_prefill(work)
                 kind = "prefill"
+                if stalled:
+                    self._m_stall.observe(
+                        (time.perf_counter() - t0) * 1e3,
+                        tags=self._m_tags)
             else:
                 self._do_decode(work)
                 kind = "decode"
@@ -294,18 +338,48 @@ class LLMEngine:
             self._m_running.set(depth["running"], tags=self._m_tags)
             self._m_cache.set(depth["cache_utilization"],
                               tags=self._m_tags)
+            self._m_cached_blocks.set(depth["blocks_cached"],
+                                      tags=self._m_tags)
+            hits, misses, evict = (depth["prefix_hit_pages"],
+                                   depth["prefix_miss_pages"],
+                                   depth["prefix_evictions"])
+            lh, lm, le = self._last_prefix
+            self._last_prefix = (hits, misses, evict)
+            if hits > lh:
+                self._m_prefix_hits.inc(hits - lh, tags=self._m_tags)
+            if misses > lm:
+                self._m_prefix_misses.inc(misses - lm, tags=self._m_tags)
+            if evict > le:
+                self._m_prefix_evict.inc(evict - le, tags=self._m_tags)
             return True
 
-    def _do_prefill(self, seq: Sequence) -> None:
-        tokens = seq.refill_tokens
+    def _do_prefill(self, work: PrefillWork) -> None:
+        seq = work.seq
+        sp = seq.sampling
+        tokens = seq.refill_tokens[work.start:work.end]
         try:
-            nxt, _ = self.runner.prefill(
-                tokens, seq.table, seq.sampling.temperature)
+            if work.start == 0 and work.is_last:
+                # whole prompt in one go and nothing cached: the
+                # monolithic program skips the context gather
+                nxt, _ = self.runner.prefill(
+                    tokens, seq.table, sp.temperature, sp.top_k, sp.top_p)
+            else:
+                nxt, _ = self.runner.prefill_chunk(
+                    tokens, work.start, seq.table, sp.temperature,
+                    sp.top_k, sp.top_p)
         except Exception as e:  # noqa: BLE001
             with self._lock:
                 self.scheduler.abort(seq, f"error:{e!r}")
             self._finalize(seq)
             return
+        self._m_chunks.inc(tags=self._m_tags)
+        with self._lock:
+            # full pages covered by this chunk are now shareable (the
+            # state check skips sequences aborted mid-flight: their
+            # pages may already belong to someone else)
+            self.scheduler.register_prefilled_pages(seq, work.end)
+        if not work.is_last:
+            return  # intermediate chunk: no token was produced
         if seq.first_token_at is None:
             self._m_ttft.observe(
                 (time.monotonic() - seq.enqueued_at) * 1e3,
@@ -322,7 +396,8 @@ class LLMEngine:
         # pos-1 (it was sampled but never cached): rope/wpe index, the
         # context mask, and the KV scatter all key off that position
         items = [DecodeItem(s.last_token, s.pos - 1, s.table,
-                            s.sampling.temperature) for s in work.seqs]
+                            s.sampling.temperature, s.sampling.top_k,
+                            s.sampling.top_p) for s in work.seqs]
         try:
             next_tokens, _ = self.runner.decode(items)
         except Exception as e:  # noqa: BLE001
@@ -366,6 +441,9 @@ class LLMEngine:
             "num_generated": len(seq.generated),
             "token_ids": list(seq.generated),
             "preemptions": seq.preemptions,
+            # prompt tokens served from the prefix cache at the last
+            # admission (vLLM/OpenAI `cached_tokens` usage field)
+            "cached_tokens": seq.cached_tokens,
         }
         if seq.sampling.echo:
             final["prompt_token_ids"] = list(seq.prompt)

@@ -53,6 +53,8 @@ class ModelAdapter:
     init_fn: Callable  # (key, cfg) -> params
     prefill_fn: Callable  # (params, tokens, cfg) -> (logits, k, v)
     decode_fn: Callable  # (params, toks, pos, kc, vc, mask, cfg) -> ...
+    # (params, toks, start, kc, vc, ctx_mask, chunk_mask, cfg) -> ...
+    chunk_fn: Callable
     rules_fn: Callable  # () -> PartitionRules
     kv_heads: Callable[[Any], int]
 
@@ -75,6 +77,7 @@ def adapters() -> dict[str, ModelAdapter]:
             init_fn=gpt2.init_gpt2,
             prefill_fn=gpt2.gpt2_prefill_kv,
             decode_fn=gpt2.gpt2_decode_kv,
+            chunk_fn=gpt2.gpt2_prefill_chunk_kv,
             rules_fn=gpt2.gpt2_partition_rules,
             kv_heads=lambda cfg: cfg.n_head,
         ),
@@ -88,6 +91,7 @@ def adapters() -> dict[str, ModelAdapter]:
             init_fn=llama.init_llama,
             prefill_fn=llama.llama_prefill_kv,
             decode_fn=llama.llama_decode_kv,
+            chunk_fn=llama.llama_prefill_chunk_kv,
             rules_fn=llama.llama_partition_rules,
             kv_heads=lambda cfg: cfg.n_kv_head,
         ),
@@ -99,6 +103,8 @@ class DecodeItem(NamedTuple):
     pos: int  # its absolute position (== tokens written so far)
     table: Sequence[int]  # physical page ids, logical order
     temperature: float
+    top_k: int = 0  # 0: disabled
+    top_p: float = 1.0  # 1.0: disabled
 
 
 def _next_pow2(n: int, lo: int) -> int:
@@ -124,6 +130,7 @@ class ModelRunner:
         max_model_len: int,
         max_batch_size: int,
         prefill_bucket_min: int = 16,
+        prefill_chunk_size: int | None = None,
         mesh=None,
         sample_seed: int = 0,
     ):
@@ -135,6 +142,14 @@ class ModelRunner:
         self.max_model_len = max_model_len
         self.max_batch_size = max_batch_size
         self.prefill_bucket_min = prefill_bucket_min
+        # chunked prefill: offsets/chunks must stay page-aligned, so the
+        # chunk size rounds up to a block multiple (and never exceeds
+        # max_model_len). None disables chunking (monolithic prefill).
+        if prefill_chunk_size is not None:
+            c = max(block_size, prefill_chunk_size)
+            c = ((c + block_size - 1) // block_size) * block_size
+            prefill_chunk_size = min(c, max_model_len)
+        self.prefill_chunk_size = prefill_chunk_size
         self.max_blocks_per_seq = (
             max_model_len + block_size - 1) // block_size
 
@@ -173,6 +188,7 @@ class ModelRunner:
         donate = (1, 2) if jax.default_backend() in ("tpu", "axon") else ()
         self._prefill_jit = jax.jit(self._prefill_impl, donate_argnums=donate)
         self._decode_jit = jax.jit(self._decode_impl, donate_argnums=donate)
+        self._chunk_jit = jax.jit(self._chunk_impl, donate_argnums=donate)
         # pages are mutated functionally; serialize compute just in case
         # a stats probe races the step loop
         self._jit_lock = threading.Lock()
@@ -199,20 +215,52 @@ class ModelRunner:
 
     # ------------------------------------------------------------- traced
 
-    def _sample(self, logits, temps, step):
-        """Greedy when temp==0, else temperature sampling; vocab padding
-        is always masked out."""
+    def _sample(self, logits, temps, topks, topps, step):
+        """Greedy when temp==0, else temperature sampling with optional
+        top-k / top-p (nucleus) truncation; vocab padding is always
+        masked out. topks (S,) i32, 0 disables; topps (S,) f32, 1.0
+        disables. All in-jit: the truncation cutoff — the only part
+        needing a full-vocab sort — sits behind a lax.cond, so a batch
+        with no truncating lane (greedy serving traffic, the common
+        case) never executes the O(S*V log V) sort at runtime, without
+        a second compiled program variant per bucket."""
         V = logits.shape[-1]
         mask = jnp.arange(V) < self.cfg.vocab_size
         logits = jnp.where(mask, logits, -1e30)
         greedy = jnp.argmax(logits, axis=-1)
-        key = jax.random.fold_in(self._base_key, step)
         safe = jnp.where(temps > 0, temps, 1.0)[:, None]
+
+        def trunc_cut(ops):
+            lg, sf, tk, tp = ops
+            desc = -jnp.sort(-lg, axis=-1)  # (S, V) descending
+            # top-k cutoff: the k-th largest logit (k==0 -> the
+            # minimum, so nothing is filtered); one sort pays for both
+            # filters
+            k_idx = jnp.clip(jnp.where(tk > 0, tk, V) - 1, 0, V - 1)
+            kth = jnp.take_along_axis(desc, k_idx[:, None], axis=-1)
+            # top-p cutoff over the temperature-scaled distribution:
+            # keep the smallest prefix of descending probs whose mass
+            # reaches top_p (the item crossing the threshold stays in)
+            p_desc = jax.nn.softmax(desc / sf, axis=-1)
+            keep = (jnp.cumsum(p_desc, axis=-1) - p_desc) < tp[:, None]
+            pth = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1,
+                          keepdims=True)
+            return jnp.maximum(kth, pth)
+
+        def no_cut(ops):
+            return jnp.full((ops[0].shape[0], 1), -jnp.inf,
+                            ops[0].dtype)
+
+        cut = jax.lax.cond(jnp.any((topks > 0) | (topps < 1.0)),
+                           trunc_cut, no_cut,
+                           (logits, safe, topks, topps))
+        logits = jnp.where(logits < cut, -jnp.inf, logits)
+        key = jax.random.fold_in(self._base_key, step)
         sampled = jax.random.categorical(key, logits / safe, axis=-1)
         return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
     def _prefill_impl(self, params, k_pages, v_pages, tokens, last_idx,
-                      block_ids, offsets, temp, step):
+                      block_ids, offsets, temp, topk, topp, step):
         """tokens (1, Tb); block_ids/offsets (Tb,) map position t to its
         page slot (padded positions -> null page 0)."""
         logits, k, v = self.adapter.prefill_fn(params, tokens, self.cfg)
@@ -220,11 +268,41 @@ class ModelRunner:
         k_pages = k_pages.at[:, block_ids, offsets].set(k[:, 0])
         v_pages = v_pages.at[:, block_ids, offsets].set(v[:, 0])
         last = jnp.take(logits[0], last_idx, axis=0)  # (Vp,)
-        nxt = self._sample(last[None, :], temp, step)[0]
+        nxt = self._sample(last[None, :], temp, topk, topp, step)[0]
+        return nxt, last, k_pages, v_pages
+
+    def _chunk_impl(self, params, k_pages, v_pages, tokens, start,
+                    last_idx, block_ids, offsets, table, temp, topk,
+                    topp, step):
+        """Prefill a chunk of ONE sequence from a position offset.
+
+        tokens (1, Tb) at absolute positions start..start+Tb-1 (padded
+        tail -> null page); table (maxB,) is the sequence's full block
+        table, gathered for context (positions < start); block_ids/
+        offsets (Tb,) map chunk position t to its page slot. `start` is
+        traced, so one compiled program per chunk-length bucket serves
+        every offset."""
+        L = self.cfg.n_layer
+        Bs = self.block_size
+        Tb = tokens.shape[1]
+        C = self.max_blocks_per_seq * Bs
+        k_ctx = k_pages[:, table]  # (L, MaxB, Bs, HK, D)
+        k_ctx = k_ctx.reshape(L, 1, C, *k_ctx.shape[3:])
+        v_ctx = v_pages[:, table]
+        v_ctx = v_ctx.reshape(L, 1, C, *v_ctx.shape[3:])
+        ctx_mask = (jnp.arange(C)[None, :] < start)  # (1, C)
+        chunk_mask = (jnp.arange(Tb)[None, :] <= last_idx)  # (1, Tb)
+        logits, k, v = self.adapter.chunk_fn(
+            params, tokens, start, k_ctx, v_ctx, ctx_mask, chunk_mask,
+            self.cfg)
+        k_pages = k_pages.at[:, block_ids, offsets].set(k[:, 0])
+        v_pages = v_pages.at[:, block_ids, offsets].set(v[:, 0])
+        last = jnp.take(logits[0], last_idx, axis=0)  # (Vp,)
+        nxt = self._sample(last[None, :], temp, topk, topp, step)[0]
         return nxt, last, k_pages, v_pages
 
     def _decode_impl(self, params, k_pages, v_pages, tokens, positions,
-                     tables, temps, step):
+                     tables, temps, topks, topps, step):
         """tokens/positions/temps (Sb,); tables (Sb, max_blocks_per_seq).
         Gather pages -> dense context, run the model's decode step,
         scatter the new K/V at each lane's position, sample."""
@@ -244,7 +322,7 @@ class ModelRunner:
         offsets = positions % Bs
         k_pages = k_pages.at[:, block_ids, offsets].set(k_new)
         v_pages = v_pages.at[:, block_ids, offsets].set(v_new)
-        nxt = self._sample(logits, temps, step)
+        nxt = self._sample(logits, temps, topks, topps, step)
         return nxt, logits, k_pages, v_pages
 
     # -------------------------------------------------------------- host
@@ -263,10 +341,17 @@ class ModelRunner:
     def decode_bucket(self, n: int) -> int:
         return min(_next_pow2(n, 1), self.max_batch_size)
 
+    def chunk_bucket(self, n: int) -> int:
+        cap = self.prefill_chunk_size or self.max_model_len
+        if n > cap:
+            raise ValueError(f"chunk of {n} tokens exceeds chunk size {cap}")
+        return min(_next_pow2(n, self.prefill_bucket_min), cap)
+
     def prefill(self, token_ids: Sequence[int], table: Sequence[int],
-                temperature: float) -> tuple[int, np.ndarray]:
-        """Run one prompt through prefill; returns (first generated
-        token, last-position logits). `table` must cover
+                temperature: float, top_k: int = 0, top_p: float = 1.0
+                ) -> tuple[int, np.ndarray]:
+        """Run one prompt through monolithic prefill; returns (first
+        generated token, last-position logits). `table` must cover
         blocks_for_tokens(len(token_ids)) pages."""
         n = len(token_ids)
         Tb = self.prefill_bucket(n)
@@ -277,6 +362,8 @@ class ModelRunner:
         pos = np.arange(n)
         block_ids[:n] = np.asarray(table, np.int32)[pos // self.block_size]
         temp = np.asarray([temperature], np.float32)
+        topk = np.asarray([top_k], np.int32)
+        topp = np.asarray([top_p], np.float32)
         self._step_counter += 1
         from ray_tpu.util.tracing import jit_cache_size
 
@@ -285,9 +372,53 @@ class ModelRunner:
         with self._mesh_ctx(), self._jit_lock:
             nxt, last, self.k_pages, self.v_pages = self._prefill_jit(
                 self.params, self.k_pages, self.v_pages, toks,
-                np.int32(n - 1), block_ids, offsets, temp,
+                np.int32(n - 1), block_ids, offsets, temp, topk, topp,
                 np.int32(self._step_counter))
         self._note_compile("prefill", self._prefill_jit, before,
+                           time.perf_counter() - t0)
+        return int(nxt), np.asarray(last)
+
+    def prefill_chunk(self, token_ids: Sequence[int], start: int,
+                      table: Sequence[int], temperature: float,
+                      top_k: int = 0, top_p: float = 1.0
+                      ) -> tuple[int, np.ndarray]:
+        """Prefill-from-offset: run `token_ids` (<= prefill_chunk_size)
+        at absolute positions start..start+n-1 against the cached
+        context in `table` (which must already hold valid KV for every
+        position < start, and own the pages the chunk writes). `start`
+        must be page-aligned. Returns (sampled next token, last-chunk-
+        position logits) — the caller only uses them on the final
+        chunk."""
+        n = len(token_ids)
+        if start % self.block_size:
+            raise ValueError(
+                f"chunk start {start} not page-aligned "
+                f"(block_size={self.block_size})")
+        Tb = self.chunk_bucket(n)
+        toks = np.zeros((1, Tb), np.int32)
+        toks[0, :n] = token_ids
+        tab = np.zeros((self.max_blocks_per_seq,), np.int32)
+        tab[:len(table)] = table
+        block_ids = np.zeros((Tb,), np.int32)
+        pos = start + np.arange(n)
+        block_ids[:n] = tab[pos // self.block_size]
+        # padded tail positions keep in-range offsets but target page 0
+        offsets = np.asarray(
+            (start + np.arange(Tb)) % self.block_size, np.int32)
+        temp = np.asarray([temperature], np.float32)
+        topk = np.asarray([top_k], np.int32)
+        topp = np.asarray([top_p], np.float32)
+        self._step_counter += 1
+        from ray_tpu.util.tracing import jit_cache_size
+
+        before = jit_cache_size(self._chunk_jit)
+        t0 = time.perf_counter()
+        with self._mesh_ctx(), self._jit_lock:
+            nxt, last, self.k_pages, self.v_pages = self._chunk_jit(
+                self.params, self.k_pages, self.v_pages, toks,
+                np.int32(start), np.int32(n - 1), block_ids, offsets,
+                tab, temp, topk, topp, np.int32(self._step_counter))
+        self._note_compile("prefill_chunk", self._chunk_jit, before,
                            time.perf_counter() - t0)
         return int(nxt), np.asarray(last)
 
@@ -303,11 +434,15 @@ class ModelRunner:
         poss = np.zeros((Sb,), np.int32)
         tables = np.zeros((Sb, self.max_blocks_per_seq), np.int32)
         temps = np.zeros((Sb,), np.float32)
+        topks = np.zeros((Sb,), np.int32)
+        topps = np.ones((Sb,), np.float32)
         for i, it in enumerate(items):
             toks[i] = it.token
             poss[i] = it.pos
             tables[i, :len(it.table)] = it.table
             temps[i] = it.temperature
+            topks[i] = it.top_k
+            topps[i] = it.top_p
         self._step_counter += 1
         from ray_tpu.util.tracing import jit_cache_size
 
@@ -316,7 +451,8 @@ class ModelRunner:
         with self._mesh_ctx(), self._jit_lock:
             nxt, logits, self.k_pages, self.v_pages = self._decode_jit(
                 self.params, self.k_pages, self.v_pages, toks, poss,
-                tables, temps, np.int32(self._step_counter))
+                tables, temps, topks, topps,
+                np.int32(self._step_counter))
         self._note_compile("decode", self._decode_jit, before,
                            time.perf_counter() - t0)
         nxt = np.asarray(nxt)
@@ -327,14 +463,29 @@ class ModelRunner:
         ever pays a mid-stream XLA compile (the TPU serving idiom:
         static shapes, all compiled at startup). All writes/reads target
         the null page, so the warm cache state is untouched as far as
-        any real sequence is concerned. Returns #programs compiled."""
+        any real sequence is concerned. Returns #programs compiled.
+
+        With chunked prefill enabled the engine only ever runs
+        monolithic prefill on prompts that fit one chunk, so both the
+        monolithic and the chunk buckets cap at prefill_chunk_size —
+        long prompts always go through the chunk program."""
         null_table = [0] * self.max_blocks_per_seq
-        b = min(self.prefill_bucket_min, self.max_model_len)
+        cap = self.prefill_chunk_size or self.max_model_len
+        b = min(self.prefill_bucket_min, cap)
         while True:
             self.prefill([1] * b, null_table, 0.0)
-            if b >= self.max_model_len:
+            if b >= cap:
                 break
-            b = min(b * 2, self.max_model_len)
+            b = min(b * 2, cap)
+        if self.prefill_chunk_size is not None:
+            b = min(self.prefill_bucket_min, cap)
+            while True:
+                # start=0 is fine: start is traced, the program is
+                # shared across offsets — only Tb shapes the compile
+                self.prefill_chunk([1] * b, 0, null_table, 0.0)
+                if b >= cap:
+                    break
+                b = min(b * 2, cap)
         s = 1
         while True:
             self.decode([DecodeItem(1, 0, null_table, 0.0)] * s)
@@ -354,6 +505,7 @@ class ModelRunner:
         Bounded by #length-buckets + #batch-buckets by construction."""
         try:
             return (self._prefill_jit._cache_size()
+                    + self._chunk_jit._cache_size()
                     + self._decode_jit._cache_size())
         except Exception:  # noqa: BLE001
             return -1

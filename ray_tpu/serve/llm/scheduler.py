@@ -1,26 +1,45 @@
 """Continuous-batching scheduler (reference shape: vLLM's scheduler,
-reduced to the TPU-static-shape essentials).
+reduced to the TPU-static-shape essentials) with automatic prefix
+caching and chunked prefill.
 
 State machine per sequence::
 
-    WAITING --admit(prefill)--> RUNNING --eos/max-tokens--> FINISHED
-       ^                          |
-       +------- preempt ----------+   (cache pool exhausted)
+    WAITING --admit--> RUNNING(prefilling -> decoding) --eos/cap--> FINISHED
+       ^                  |
+       +---- preempt -----+   (cache pool exhausted)
 
 Policy, chosen per step by `schedule()`:
 
-- **prefill-first**: if a waiting sequence fits (a free decode lane AND
-  enough free pages for its prompt), admit it — keeping lanes full
-  maximizes decode batch size, which is where TPU throughput lives;
-- otherwise **decode** every running sequence in one batched step;
-- before a decode step, any lane crossing a page boundary gets one new
-  page; if the pool is dry, the **most recently admitted** lane is
-  preempted (recompute-style: its pages are freed and it re-enters the
+- **prefill-first admission**: if a waiting sequence fits (a free
+  decode lane AND enough free pages), admit it. Admission first runs a
+  longest-prefix match against the content-addressed pool — full pages
+  whose hash chain is already cached are *shared* (refcount +1) and
+  skipped entirely; only the remaining pages are allocated and only the
+  remaining tokens are prefilled;
+- an admitted sequence prefills its (unmatched) prompt in page-aligned
+  **chunks** of at most `chunk_size` tokens. Continuation chunks
+  alternate with decode steps, so one long prompt stalls the decode
+  batch by at most one chunk's latency instead of its whole prefill;
+- otherwise **decode** every fully-prefilled sequence in one batched
+  step; before it, any lane crossing a page boundary gets one new page;
+  if the pool is dry, the **most recently admitted** lane is preempted
+  (recompute-style: its page refs are dropped and it re-enters the
   waiting queue FRONT with prompt+generated as its new prompt — with
   greedy sampling its continuation is bit-identical, which the tests
   assert). LIFO victim choice protects the oldest sequences' progress.
+  A preempted sequence's pages usually survive in the pool's LRU, so
+  its re-admission prefix-matches them back instead of re-prefilling.
 
-The scheduler owns no locks: the engine serializes calls.
+Page registration: a page becomes shareable the moment its KV content
+is completely written — after the prefill chunk covering it, or after
+the decode step that fills its last slot. The hash chain covers
+prompt AND generated tokens, so shared prefixes survive preemption and
+even extend into generated text (RL-style rollouts forking one prompt).
+
+The scheduler owns no locks: the engine serializes calls. The pool's
+internal `_lock` is a leaf — taken inside pool calls only, never
+around scheduler state — so there is no lock-order cycle with the
+engine's `_lock`.
 """
 
 from __future__ import annotations
@@ -30,7 +49,11 @@ import enum
 import time
 from collections import deque
 
-from ray_tpu.serve.llm.cache import BlockPool, CacheExhausted
+from ray_tpu.serve.llm.cache import (
+    BlockPool,
+    CacheExhausted,
+    hash_page,
+)
 from ray_tpu.serve.llm.config import SamplingParams
 
 
@@ -52,9 +75,23 @@ class Sequence:
     table: list[int] = dataclasses.field(default_factory=list)
     last_token: int = -1  # input to the next decode step
     preemptions: int = 0
+    # chunked-prefill progress: [0, prefilled) of refill_tokens is
+    # scattered into `table`; the goal is `prefill_target` (the refill
+    # length at admission — refill_tokens keeps growing as decode
+    # appends, but those positions are written by decode steps). The
+    # scheduler marks a chunk prefilled when it ISSUES the work; the
+    # engine executes it before the next schedule() call.
+    prefilled: int = 0
+    prefill_target: int = 0
+    # prefix-cache accounting: tokens skipped at the last admission,
+    # and how many leading pages of `table` are content-registered
+    cached_tokens: int = 0
+    registered_pages: int = 0
     enqueued_at: float = dataclasses.field(default_factory=time.monotonic)
     first_token_at: float | None = None
     finish_reason: str | None = None
+    # lazily extended hash chain over prompt+generated full pages
+    _hashes: list[int] = dataclasses.field(default_factory=list)
 
     @property
     def refill_tokens(self) -> list[int]:
@@ -70,13 +107,38 @@ class Sequence:
         writes its KV there."""
         return len(self.prompt) + len(self.generated)
 
+    @property
+    def prefill_pending(self) -> bool:
+        return self.state is SeqState.RUNNING \
+            and self.prefilled < self.prefill_target
+
+    def page_hashes(self, n_pages: int, block_size: int) -> list[int]:
+        """Hash chain over the first `n_pages` full pages of
+        prompt+generated (extends the cached chain; earlier entries are
+        append-only stable because tokens only ever append)."""
+        if n_pages > len(self._hashes):
+            all_tokens = self.prompt + self.generated
+            prev = self._hashes[-1] if self._hashes else 0
+            for k in range(len(self._hashes), n_pages):
+                prev = hash_page(
+                    prev, all_tokens[k * block_size:(k + 1) * block_size])
+                self._hashes.append(prev)
+        return self._hashes[:n_pages]
+
     def eos_hit(self, token: int) -> bool:
         return token in self.sampling.eos_set()
 
 
 @dataclasses.dataclass
 class PrefillWork:
+    """Prefill refill_tokens[start:end] at position offset `start`
+    (page-aligned). `is_last` marks the chunk that reaches the end of
+    the prompt — the engine samples the first generated token from it."""
+
     seq: Sequence
+    start: int = 0
+    end: int = 0
+    is_last: bool = True
 
 
 @dataclasses.dataclass
@@ -86,13 +148,19 @@ class DecodeWork:
 
 class Scheduler:
     def __init__(self, pool: BlockPool, *, max_batch_size: int,
-                 max_model_len: int):
+                 max_model_len: int, chunk_size: int = 0):
         self.pool = pool
         self.max_batch_size = max_batch_size
         self.max_model_len = max_model_len
+        # page-aligned by construction (the engine rounds it); 0 means
+        # "whole prompt in one chunk" (monolithic prefill)
+        self.chunk_size = chunk_size
         self.waiting: deque[Sequence] = deque()
         self.running: list[Sequence] = []  # admission order (LIFO victim)
         self.preemption_count = 0
+        self.prefix_hit_pages = 0
+        self.prefix_miss_pages = 0
+        self._last_was_prefill = False
         # sequences retired INSIDE schedule() (length cap backstop,
         # cache_exhausted fail-loud) — the engine drains these every
         # step so their streams still get closed
@@ -122,25 +190,69 @@ class Scheduler:
     def schedule(self) -> PrefillWork | DecodeWork | None:
         """Pick the next unit of work. Admission never preempts: a
         waiting sequence only enters when pages are genuinely free."""
-        if self.waiting and len(self.running) < self.max_batch_size:
-            seq = self.waiting[0]
-            need = self.pool.blocks_for_tokens(len(seq.refill_tokens))
-            if self.pool.can_alloc(need):
-                self.waiting.popleft()
-                seq.table = self.pool.alloc(need)
-                seq.state = SeqState.RUNNING
-                self.running.append(seq)
-                return PrefillWork(seq)
-        if not self.running:
+        work = self._try_admit()
+        if work is not None:
+            self._last_was_prefill = True
+            return work
+        pending = [s for s in self.running if s.prefill_pending]
+        ready = [s for s in self.running if not s.prefill_pending]
+        if pending and not (self._last_was_prefill and ready):
+            # continuation chunk; alternate with decode when both kinds
+            # of work exist so a long prompt can't monopolize steps
+            self._last_was_prefill = True
+            return self._next_chunk(pending[0])
+        if not ready:
+            if pending:  # nothing decodable yet: keep prefilling
+                self._last_was_prefill = True
+                return self._next_chunk(pending[0])
             return None
+        self._last_was_prefill = False
         self._grow_tables_or_preempt()
-        if not self.running:
+        ready = [s for s in self.running if not s.prefill_pending]
+        if not ready:
             return None
-        return DecodeWork(list(self.running))
+        return DecodeWork(ready)
+
+    def _try_admit(self) -> PrefillWork | None:
+        if not (self.waiting and len(self.running) < self.max_batch_size):
+            return None
+        seq = self.waiting[0]
+        total = len(seq.refill_tokens)
+        n_pages = self.pool.blocks_for_tokens(total)
+        bs = self.pool.block_size
+        # longest-prefix match over FULL pages, capped so at least one
+        # token is left to prefill (its logits sample the first token)
+        matched = self.pool.match_prefix(
+            seq.page_hashes((total - 1) // bs, bs))
+        if not self.pool.can_alloc(n_pages - len(matched)):
+            if matched:
+                self.pool.free(matched)  # drop the refs; stay queued
+            return None
+        self.waiting.popleft()
+        self.prefix_hit_pages += len(matched)
+        self.prefix_miss_pages += n_pages - len(matched)
+        seq.table = matched + self.pool.alloc(n_pages - len(matched))
+        seq.prefilled = len(matched) * bs
+        seq.prefill_target = total
+        seq.cached_tokens = seq.prefilled
+        seq.registered_pages = len(matched)
+        seq.state = SeqState.RUNNING
+        self.running.append(seq)
+        return self._next_chunk(seq)
+
+    def _next_chunk(self, seq: Sequence) -> PrefillWork:
+        total = seq.prefill_target
+        start = seq.prefilled
+        end = min(total, start + (self.chunk_size or total))
+        seq.prefilled = end  # issued == done: the engine runs it now
+        return PrefillWork(seq=seq, start=start, end=end,
+                           is_last=(end == total))
 
     def _grow_tables_or_preempt(self) -> None:
-        """Every running lane must own the page its next token writes
-        into; preempt (LIFO) until the survivors all fit."""
+        """Every decoding lane must own the page its next token writes
+        into; preempt (LIFO) until the survivors all fit. Lanes still
+        mid-prefill already own their whole table (admission allocates
+        it), so they pass through untouched."""
         i = 0
         while i < len(self.running):
             seq = self.running[i]
@@ -173,11 +285,17 @@ class Scheduler:
                     continue  # re-examine slot i (new occupant)
 
     def preempt(self, seq: Sequence) -> None:
-        """Recompute-style: free pages, requeue at the FRONT so the
-        victim re-admits as soon as space frees up."""
+        """Recompute-style: drop page refs, requeue at the FRONT so the
+        victim re-admits as soon as space frees up. Registered pages the
+        victim doesn't share park in the pool's LRU — re-admission
+        usually prefix-matches them straight back."""
         self.running.remove(seq)
         self.pool.free(seq.table)
         seq.table = []
+        seq.prefilled = 0
+        seq.prefill_target = 0
+        seq.cached_tokens = 0
+        seq.registered_pages = 0
         seq.state = SeqState.WAITING
         seq.preemptions += 1
         self.preemption_count += 1
@@ -192,6 +310,9 @@ class Scheduler:
         seq.last_token = token
         if seq.first_token_at is None:
             seq.first_token_at = time.monotonic()
+        # the decode step that produced `token` wrote KV at the previous
+        # position — any page it completed is now shareable
+        self.register_prefilled_pages(seq, seq.pos - 1)
         if seq.eos_hit(token):
             self._retire(seq, "eos")
             return True
@@ -202,6 +323,23 @@ class Scheduler:
             self._retire(seq, "length")
             return True
         return False
+
+    def register_prefilled_pages(self, seq: Sequence,
+                                 upto_tokens: int) -> None:
+        """Content-register every full page of `seq` whose KV is
+        completely written (positions 0..upto_tokens-1). Idempotent via
+        seq.registered_pages."""
+        if not self.pool.enable_prefix_cache \
+                or seq.state is SeqState.FINISHED:
+            return
+        bs = self.pool.block_size
+        full = min(upto_tokens // bs, len(seq.table))
+        if full <= seq.registered_pages:
+            return
+        hashes = seq.page_hashes(full, bs)
+        for k in range(seq.registered_pages, full):
+            self.pool.register(seq.table[k], hashes[k])
+        seq.registered_pages = full
 
     def _retire(self, seq: Sequence, reason: str) -> None:
         if seq in self.running:
@@ -223,11 +361,16 @@ class Scheduler:
     # ------------------------------------------------------------- stats
 
     def depth(self) -> dict:
+        ps = self.pool.stats()
         return {
             "waiting": len(self.waiting),
             "running": len(self.running),
             "blocks_used": self.pool.num_used(),
             "blocks_total": self.pool.usable_blocks,
+            "blocks_cached": ps["cached"],
             "cache_utilization": self.pool.utilization(),
             "preemptions": self.preemption_count,
+            "prefix_hit_pages": self.prefix_hit_pages,
+            "prefix_miss_pages": self.prefix_miss_pages,
+            "prefix_evictions": ps["evictions"],
         }

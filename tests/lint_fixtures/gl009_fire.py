@@ -1,0 +1,40 @@
+"""GL009 firing fixture: inverted nested lock acquisition orders."""
+
+import threading
+
+
+class Engine:
+    def __init__(self, store):
+        self._lock = threading.Lock()
+        self.store = store
+
+    def submit(self, item):
+        with self._lock:
+            with self.store._store_lock:  # defines _lock -> store lock
+                self.store.put(item)
+
+    def drain(self):
+        with self.store._store_lock:
+            with self._lock:  # FIRE: inverted vs submit
+                return list(self.store.items)
+
+    def stats(self):
+        with self.store._store_lock:
+            with self._lock:  # FIRE: same inversion, second site
+                return len(self.store.items)
+
+
+class Pool:
+    def __init__(self):
+        self._alloc_lock = threading.Lock()
+        self._evict_lock = threading.Lock()
+
+    def grow(self):
+        with self._alloc_lock:
+            with self._evict_lock:  # defines alloc -> evict
+                self.pages += 1
+
+    def shrink(self):
+        with self._evict_lock:
+            with self._alloc_lock:  # FIRE: inverted vs grow
+                self.pages -= 1

@@ -1,0 +1,41 @@
+"""GL009 non-firing fixture: one consistent order, non-lock contexts,
+distinct classes, and sequential (non-nested) acquisitions."""
+
+import threading
+
+
+class Engine:
+    def __init__(self, store):
+        self._lock = threading.Lock()
+        self.store = store
+
+    def submit(self, item):
+        with self._lock:
+            with self.store._store_lock:  # same order everywhere
+                self.store.put(item)
+
+    def drain(self):
+        with self._lock:
+            with self.store._store_lock:
+                return list(self.store.items)
+
+    def reopen(self, path):
+        with self._lock:
+            with open(path) as f:  # not a lock: ignored
+                return f.read()
+
+
+class Other:
+    def reversed_names_other_class(self):
+        # the same NAMES as Engine's pair, but a different class means
+        # different lock objects — not an inversion of Engine's order
+        with self.store._store_lock:
+            with self._lock:
+                return self.snapshot()
+
+
+def flat(a_lock, b_lock):
+    with a_lock:
+        pass
+    with b_lock:  # sequential, not nested: no ordering constraint
+        pass

@@ -56,6 +56,59 @@ def test_block_pool_alloc_free_and_null_page():
     assert pool.blocks_for_tokens(5) == 2
 
 
+def test_block_pool_prefix_index_refcount_and_lru():
+    """The tentpole's bookkeeping invariants: content-registered pages
+    survive release in an LRU, match_prefix revives + refcounts them,
+    shared pages outlive any single owner, and eviction recycles the
+    coldest cached page first."""
+    from ray_tpu.serve.llm.cache import chain_hashes
+
+    pool = BlockPool(num_blocks=6, block_size=4)  # 5 usable
+    toks = list(range(1, 13))  # 3 full pages worth
+    h = chain_hashes(toks, 4, 3)
+    assert h == chain_hashes(toks, 4, 3)  # deterministic
+    assert h[:2] == chain_hashes(toks[:8] + [99, 98, 97, 96], 4, 3)[:2]
+
+    a = pool.alloc(2)
+    pool.register(a[0], h[0])
+    pool.register(a[1], h[1])
+    # a second sequence with the same prefix shares the pages
+    m = pool.match_prefix(h[:2])
+    assert m == a
+    assert pool.refcount(a[0]) == 2
+    pool.free(a)  # first owner leaves: pages stay pinned by the second
+    assert pool.refcount(a[0]) == 1
+    assert pool.num_cached() == 0
+    pool.free(m)  # last ref: registered pages PARK, not free
+    assert pool.num_cached() == 2
+    assert pool.num_free() == 5  # still allocatable (evictable)
+    assert pool.num_used() == 0
+
+    # revival out of the LRU
+    m2 = pool.match_prefix(h)  # 3rd hash unknown: partial match
+    assert m2 == a and pool.num_cached() == 0
+    pool.free(m2)
+
+    # eviction order: coldest first, and a freed chain parks TAIL-first
+    # so eviction shrinks a cached prefix from its tail, never orphaning
+    # the pages behind a missing head. Allocate 4 of 5 usable pages —
+    # the 3 truly-free pages go first, then the LRU's oldest (a[1]).
+    b = pool.alloc(4)
+    assert a[1] in b and a[0] not in b
+    assert pool.evictions == 1
+    # the chain HEAD survives: a fresh match still reuses the first
+    # page and stops at the evicted tail
+    m3 = pool.match_prefix(h[:2])
+    assert m3 == [a[0]]
+    # first-writer-wins: a[0] still owns h[0]; re-registering that hash
+    # on another page is a no-op and the original stays matchable
+    pool.register(b[0], h[0])
+    assert pool.match_prefix([h[0]]) == [a[0]]
+    pool.free([a[0]])  # ref from the h[:2] match
+    pool.free([a[0]])  # ref from the [h[0]] match
+    pool.free(b)
+
+
 # ----------------------------------------------------------- decode parity
 
 
@@ -201,18 +254,60 @@ def test_scheduler_preempts_lifo_and_requeues_front():
     assert s2.refill_tokens == s2.prompt + [5]  # resume keeps progress
 
 
+# ---------------------------------------------------- scheduler chunking
+
+
+def test_scheduler_chunks_interleave_with_decode():
+    """A long prompt prefills in page-aligned chunks and continuation
+    chunks ALTERNATE with decode steps — one admission can no longer
+    monopolize consecutive engine steps."""
+    pool = BlockPool(num_blocks=64, block_size=4)
+    sched = Scheduler(pool, max_batch_size=4, max_model_len=64,
+                      chunk_size=8)
+    s1 = _mk_seq(0, 6, max_tokens=8)
+    sched.add(s1)
+    w = sched.schedule()
+    assert isinstance(w, PrefillWork) and w.seq is s1
+    assert (w.start, w.end, w.is_last) == (0, 6, True)  # fits one chunk
+    sched.commit_token(s1, 42)  # decode-ready
+
+    # a DISTINCT prompt (no shared prefix, so no pages get skipped)
+    s2 = Sequence(seq_id=1, prompt=list(range(100, 124)),
+                  sampling=SamplingParams(max_tokens=8))
+    sched.add(s2)
+    w = sched.schedule()  # admission is still prefill-first
+    assert isinstance(w, PrefillWork) and w.seq is s2
+    assert (w.start, w.end, w.is_last) == (0, 8, False)
+    w = sched.schedule()  # decode slips in between chunks
+    assert isinstance(w, DecodeWork) and w.seqs == [s1]
+    sched.commit_token(s1, 43)
+    w = sched.schedule()
+    assert isinstance(w, PrefillWork) and (w.start, w.end) == (8, 16)
+    w = sched.schedule()
+    assert isinstance(w, DecodeWork) and w.seqs == [s1]
+    sched.commit_token(s1, 44)
+    w = sched.schedule()
+    assert isinstance(w, PrefillWork) and (w.start, w.end) == (16, 24)
+    assert w.is_last
+    sched.commit_token(s2, 45)
+    w = sched.schedule()  # both lanes decode together now
+    assert isinstance(w, DecodeWork) and w.seqs == [s1, s2]
+
+
 # ------------------------------------------------------------ engine level
 
 
-def _f32_engine(num_blocks, max_batch_size=4, seed=0):
+def _f32_engine(num_blocks, max_batch_size=4, seed=0, chunk=256,
+                prefix_cache=True, max_model_len=32):
     from ray_tpu.models import gpt2
 
     cfg = dataclasses.replace(gpt2.GPT2Config.tiny(), dtype=jnp.float32,
                               remat=False)
     return LLMEngine(EngineConfig(
         model="gpt2", model_config=cfg, block_size=4,
-        num_blocks=num_blocks, max_model_len=32,
-        max_batch_size=max_batch_size, seed=seed))
+        num_blocks=num_blocks, max_model_len=max_model_len,
+        max_batch_size=max_batch_size, seed=seed,
+        prefill_chunk_size=chunk, enable_prefix_cache=prefix_cache))
 
 
 def _drive(engine, streams):
@@ -293,6 +388,171 @@ def test_eos_completion():
     # generation halts at the FIRST occurrence of the eos token
     first = toks.index(eos)
     assert stopped["token_ids"] == toks[:first + 1]
+
+
+def test_chunked_prefill_parity_vs_monolithic():
+    """Chunked prefill (page-aligned chunks via the prefill-from-offset
+    program) must reproduce monolithic prefill bit-identically under
+    greedy sampling, for both model families."""
+    from ray_tpu.models import llama
+
+    rng = np.random.RandomState(17)
+    prompt = rng.randint(1, 500, size=21).tolist()
+    sp = SamplingParams(max_tokens=8)
+
+    mono = _f32_engine(num_blocks=64, chunk=0, prefix_cache=False)
+    want = mono.generate(prompt, sp, drive=True)["token_ids"]
+    chunked = _f32_engine(num_blocks=64, chunk=8, prefix_cache=False)
+    got = chunked.generate(prompt, sp, drive=True)["token_ids"]
+    assert got == want, "gpt2 chunked prefill diverged from monolithic"
+
+    lcfg = llama.LlamaConfig.tiny()
+    lp = rng.randint(1, lcfg.vocab_size, size=19).tolist()
+
+    def llama_eng(chunk):
+        return LLMEngine(EngineConfig(
+            model="llama", model_config=lcfg, block_size=4,
+            num_blocks=64, max_model_len=32, max_batch_size=4,
+            prefill_chunk_size=chunk, enable_prefix_cache=False))
+
+    lw = llama_eng(0).generate(lp, sp, drive=True)["token_ids"]
+    lg = llama_eng(8).generate(lp, sp, drive=True)["token_ids"]
+    assert lg == lw, "llama chunked prefill diverged from monolithic"
+
+
+def test_prefix_cache_hit_parity_and_counters():
+    """Warm-cache generation (prefix pages shared, prefill skipped) is
+    bit-identical to the cold greedy run, and the hit/skip shows up in
+    the engine's counters."""
+    rng = np.random.RandomState(23)
+    shared = rng.randint(1, 500, size=16).tolist()  # 4 full pages
+    suffixes = [rng.randint(1, 500, size=3).tolist() for _ in range(3)]
+    sp = SamplingParams(max_tokens=8)
+
+    # cold references from per-prompt fresh engines (no reuse possible)
+    want = [
+        _f32_engine(num_blocks=96, chunk=8).generate(
+            shared + sfx, sp, drive=True)["token_ids"]
+        for sfx in suffixes]
+
+    eng = _f32_engine(num_blocks=96, chunk=8)
+    got, cached = [], []
+    for sfx in suffixes:
+        stream = eng.add_request(shared + sfx, sp)
+        _drive(eng, [stream])
+        fin = stream.final()
+        got.append(fin["token_ids"])
+        cached.append(fin["cached_tokens"])
+    st = eng.stats()
+    assert got == want, "warm prefix-cache output diverged from cold"
+    # 2nd and 3rd requests each match the 4 shared full pages, and the
+    # final event reports the reused tokens
+    assert cached == [0, 16, 16], cached
+    assert st["prefix_hit_pages"] >= 8, st
+    assert st["blocks_used"] == 0  # all refs released
+    assert st["blocks_cached"] > 0  # ...but pages parked for reuse
+    from ray_tpu.util.metrics import prometheus_text
+
+    text = prometheus_text()
+    for name in ("serve_llm_prefix_cache_hits_total",
+                 "serve_llm_prefix_cache_misses_total",
+                 "serve_llm_prefill_chunks_total"):
+        assert name in text, f"missing metric {name}"
+
+
+def test_preemption_while_prefix_shared():
+    """Two sequences share prefix pages; cache pressure preempts one.
+    The victim's dropped refs must not invalidate the survivor's shared
+    pages, and BOTH must finish bit-identical to an unconstrained run
+    (the refcounting acceptance gate)."""
+    rng = np.random.RandomState(29)
+    shared = rng.randint(1, 500, size=12).tolist()  # 3 pages, 2 matchable
+    prompts = [shared + rng.randint(1, 500, size=2).tolist(),
+               shared + rng.randint(1, 500, size=3).tolist()]
+    sp = SamplingParams(max_tokens=10)
+
+    want = [
+        _f32_engine(num_blocks=64, chunk=8).generate(
+            p, sp, drive=True)["token_ids"] for p in prompts]
+
+    tight = _f32_engine(num_blocks=10, chunk=8)  # 9 usable pages
+    streams = [tight.add_request(p, sp) for p in prompts]
+    finals = _drive(tight, streams)
+    assert tight.scheduler.preemption_count > 0, \
+        "pool was sized to force preemption under sharing"
+    assert tight.scheduler.prefix_hit_pages > 0, \
+        "second sequence should share the prefix pages"
+    for f, expect in zip(finals, want):
+        assert f["token_ids"] == expect, \
+            "sharing + preemption changed greedy output"
+    st = tight.stats()
+    assert st["blocks_used"] == 0
+
+
+def test_compile_misses_bounded_after_warmup():
+    """The recompilation acceptance gate: after warmup() no request mix
+    (short, long/chunked, warm-prefix, preempting) may trigger another
+    XLA compile — serve_llm_compile_misses_total must not move."""
+    from ray_tpu.util.metrics import prometheus_text
+
+    def misses():
+        total = 0.0
+        for line in prometheus_text().splitlines():
+            if line.startswith("serve_llm_compile_misses_total{"):
+                total += float(line.rsplit(" ", 1)[1])
+        return total
+
+    eng = _f32_engine(num_blocks=24, chunk=8, max_batch_size=2)
+    eng.warmup()
+    base = misses()
+    rng = np.random.RandomState(31)
+    shared = rng.randint(1, 500, size=10).tolist()
+    sp = SamplingParams(max_tokens=6)
+    for n in (3, 17, 25):  # one-chunk, multi-chunk, multi-chunk
+        eng.generate(rng.randint(1, 500, size=n).tolist(), sp,
+                     drive=True)
+    for _ in range(2):  # warm-prefix path (prefill from offset)
+        eng.generate(shared + rng.randint(1, 500, size=2).tolist(), sp,
+                     drive=True)
+    streams = [eng.add_request(
+        rng.randint(1, 500, size=12).tolist(),
+        SamplingParams(max_tokens=12)) for _ in range(2)]
+    _drive(eng, streams)  # small pool: decode growth under pressure
+    assert misses() == base, \
+        "a request mix recompiled after warmup (unbounded programs)"
+
+
+def test_topk_topp_sampling():
+    """Satellite gate: top-k/top-p run in-jit. Degenerate settings
+    reduce to greedy (bit-identical), and a top-k=2 stream only ever
+    emits tokens from the greedy top-2 at each step."""
+    from ray_tpu.models import gpt2
+
+    prompt = list(range(1, 9))
+    base = _f32_engine(num_blocks=64)
+    want = base.generate(prompt, SamplingParams(max_tokens=6),
+                         drive=True)["token_ids"]
+    k1 = _f32_engine(num_blocks=64).generate(
+        prompt, SamplingParams(max_tokens=6, temperature=1.0, top_k=1),
+        drive=True)["token_ids"]
+    assert k1 == want, "top_k=1 must reduce to greedy"
+    p0 = _f32_engine(num_blocks=64).generate(
+        prompt, SamplingParams(max_tokens=6, temperature=1.0,
+                               top_p=1e-9), drive=True)["token_ids"]
+    assert p0 == want, "top_p->0 must reduce to greedy"
+
+    eng = _f32_engine(num_blocks=64, seed=7)
+    out = eng.generate(prompt, SamplingParams(
+        max_tokens=8, temperature=1.5, top_k=2), drive=True)
+    cfg = eng.model_cfg
+    toks = list(prompt)
+    for tok in out["token_ids"]:
+        full = np.asarray(gpt2.gpt2_forward(
+            eng.runner.params, jnp.asarray([toks], jnp.int32), cfg))[0]
+        logits = full[-1][:cfg.vocab_size]
+        top2 = set(np.argsort(logits)[-2:].tolist())
+        assert tok in top2, (tok, top2)
+        toks.append(tok)
 
 
 def test_engine_concurrent_requests_zero_drops():
@@ -400,3 +660,43 @@ def test_llm_deployment_8_concurrent_streams(llm_cluster):
         assert stats[0]["running"] == 0 and stats[0]["waiting"] == 0
     finally:
         serve.delete("llm")
+
+
+def test_affinity_routing_concentrates_shared_prefix(llm_cluster):
+    """Prefix-affinity routing: requests sharing a prompt prefix carry
+    the same affinity key, rendezvous onto ONE of two replicas, and
+    that replica's prefix cache serves the shared pages — the hits show
+    up on exactly one engine."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import build_llm_app, prompt_affinity_key
+
+    app = build_llm_app(
+        model="gpt2", preset="tiny", num_replicas=2,
+        engine_config={"block_size": 8, "num_blocks": 96,
+                       "max_model_len": 64, "max_batch_size": 8,
+                       "prefill_chunk_size": 16},
+        max_ongoing_requests=16)
+    handle = serve.run(app, name="llm-aff")
+    try:
+        rng = np.random.RandomState(9)
+        shared = rng.randint(1, 500, size=24).tolist()  # 3 full pages
+        for _ in range(4):
+            p = shared + rng.randint(1, 500, size=2).tolist()
+            sh = handle.options(stream=True,
+                                affinity_key=prompt_affinity_key(p))
+            events = [ray_tpu.get(r, timeout=120)
+                      for r in sh.remote({"prompt": p, "max_tokens": 3})]
+            assert events[-1]["done"]
+
+        from ray_tpu.util.state import llm_status
+
+        stats = llm_status("llm-aff")
+        assert len(stats) == 2
+        hits = [s.get("prefix_hit_pages", 0) for s in stats]
+        # 3 warm requests x 3 shared pages, all on the SAME replica
+        assert sum(hits) >= 9, stats
+        assert max(hits) == sum(hits), \
+            f"affinity routing scattered a shared prefix: {hits}"
+    finally:
+        serve.delete("llm-aff")
