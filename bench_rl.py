@@ -1,0 +1,229 @@
+"""RL-for-LLMs flywheel benchmark — the closed loop, end to end on CPU.
+
+Two parts, two disciplines:
+
+- **Learning curve** (the closed-loop acceptance artifact): N flywheel
+  laps of rollout → GRPO update → drain-free weight hot-swap on the
+  digit-sum verifiable task, every rollout generated through the
+  serve.llm continuous-batching engine (the shared task prefix rides
+  the prefix cache — hit counters prove it), every lap hot-swapping
+  the new weights while probe streams are mid-generation (zero drops
+  proves the drain-free contract). Fully seeded, so the committed
+  curve reproduces; the gate is a run of >= 4 consecutive laps with
+  strictly increasing mean reward (>= 3 strictly-improving learner
+  updates).
+
+- **Perf numbers** (PERF_NOTES round-5 recipe: idle gate, median of 7
+  samples, stdev on the control metric, retry-on-variance): rollout
+  throughput in generated tokens/s through the engine, and the wall
+  time of one weight hot-swap with 8 streams in flight.
+
+Emits one BENCH-style JSON line and writes RL_BENCH.json (rollout
+tokens/s, prefix-cache hit ratio during rollouts, swap latency, the
+reward curve).
+
+    python bench_rl.py [--iters 12] [--prompts 12] [--group 8]
+                       [--samples 7] [--lr 0.02] [--seed 0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _build(args):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.rllib.llm import (
+        DigitSumTask,
+        LLMLearner,
+        LLMLearnerConfig,
+        RolloutConfig,
+        RolloutWorker,
+    )
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine
+
+    jax.config.update("jax_platforms", "cpu")
+    task = DigitSumTask()
+    cfg = gpt2.GPT2Config(
+        vocab_size=64, n_layer=1, n_head=2, n_embd=32, block_size=64,
+        vocab_pad_multiple=64, dtype=jnp.float32, remat=False)
+    learner = LLMLearner(
+        "gpt2", cfg,
+        config=LLMLearnerConfig(lr=args.lr, temperature=1.0),
+        seed=args.seed)
+    engine = LLMEngine(
+        EngineConfig(model="gpt2", model_config=cfg, block_size=8,
+                     num_blocks=256, max_model_len=32, max_batch_size=8,
+                     prefill_chunk_size=8, seed=args.seed),
+        params=learner.get_weights())
+    worker = RolloutWorker(
+        engine=engine, reward_fn=task.reward,
+        config=RolloutConfig(group_size=args.group, max_tokens=2,
+                             temperature=1.0))
+    return task, learner, engine, worker
+
+
+def bench_learning_curve(args) -> dict:
+    """The closed loop: reward must strictly improve across >= 3
+    consecutive learner updates while every lap's hot-swap lands with
+    probe streams in flight and drops none."""
+    from ray_tpu.rllib.llm import FlywheelConfig, RLFlywheel
+
+    task, learner, engine, worker = _build(args)
+    rng = np.random.RandomState(args.seed)
+
+    def prompt_fn(it):
+        return [task.make_prompt(rng.randint(0, 10), rng.randint(0, 10))
+                for _ in range(args.prompts)]
+
+    fly = RLFlywheel(worker, learner, prompt_fn,
+                     FlywheelConfig(swap_during_rollout=True))
+    curve, probe_dropped, probe_streams = [], 0, 0
+    min_in_flight = 10 ** 9
+    t0 = time.monotonic()
+    for _ in range(args.iters):
+        m = fly.iteration()
+        curve.append(round(m["rollout_reward_mean"], 4))
+        probe_dropped += m["swap"]["probe_dropped"]
+        probe_streams += m["swap"]["probe_streams"]
+        min_in_flight = min(min_in_flight, m["swap"]["in_flight_streams"])
+    wall = time.monotonic() - t0
+    stats = engine.stats()
+    hits, misses = stats["prefix_hit_pages"], stats["prefix_miss_pages"]
+
+    # longest strictly-increasing run of consecutive lap rewards
+    best_run, run = 1, 1
+    for a, b in zip(curve, curve[1:]):
+        run = run + 1 if b > a else 1
+        best_run = max(best_run, run)
+    return {
+        "reward_curve": curve,
+        "reward_first": curve[0],
+        "reward_last": curve[-1],
+        "strict_improve_updates": best_run - 1,
+        # every gate the acceptance demands: learning, a warm cache,
+        # and swaps that provably landed with streams mid-generation
+        "closed_loop_ok": bool(best_run - 1 >= 3 and probe_dropped == 0
+                               and hits > 0 and min_in_flight >= 1),
+        "min_swap_in_flight_streams": min_in_flight,
+        "learner_updates": learner.version,
+        "engine_weight_version": stats["weight_version"],
+        "swaps_with_streams_in_flight": args.iters,
+        "probe_streams": probe_streams,
+        "probe_dropped": probe_dropped,
+        "prefix_hit_pages": hits,
+        "prefix_miss_pages": misses,
+        "prefix_hit_ratio": round(hits / max(1, hits + misses), 3),
+        "wall_s": round(wall, 1),
+    }
+
+
+def bench_perf(args) -> dict:
+    """Round-5 recipe over (rollout tokens/s, swap seconds): each
+    sample rolls one full batch through the engine and then hot-swaps
+    fresh weights with 8 streams held in flight."""
+    import jax
+
+    from bench_serve import _recipe
+    from ray_tpu.models import gpt2
+    from ray_tpu.serve.llm import SamplingParams
+
+    task, learner, engine, worker = _build(args)
+    rng = np.random.RandomState(args.seed + 1)
+    version = [engine.weight_version]
+
+    def sample(i) -> dict:
+        prompts = [task.make_prompt(rng.randint(0, 10),
+                                    rng.randint(0, 10))
+                   for _ in range(args.prompts)]
+        t0 = time.monotonic()
+        trajs = worker.rollout(prompts)
+        dt = time.monotonic() - t0
+        tokens = sum(len(t) for t in trajs)
+        # swap with 8 streams mid-generation (the drain-free shape)
+        sp = SamplingParams(max_tokens=8, temperature=1.0)
+        streams = [engine.add_request(p, sp) for p in prompts[:8]]
+        for _ in range(10):
+            engine.step()
+        version[0] += 1
+        new = gpt2.init_gpt2(
+            jax.random.PRNGKey(args.seed + version[0]), learner.cfg)
+        swap = engine.update_weights(version[0], new)
+        while any(s.final() is None for s in streams):
+            if not engine.step():
+                time.sleep(0.001)
+        dropped = sum(1 for s in streams
+                      if not (s.final() and s.final().get("done")))
+        return {
+            "rollout_tokens_per_sec": tokens / dt,
+            "rollout_tokens": tokens,
+            "swap_seconds": swap["swap_seconds"],
+            "swap_in_flight_streams": swap["in_flight_streams"],
+            "swap_dropped_streams": dropped,
+        }
+
+    return _recipe(sample, samples=args.samples,
+                   control_key="rollout_tokens_per_sec")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=12,
+                    help="flywheel laps for the learning curve")
+    ap.add_argument("--prompts", type=int, default=12,
+                    help="prompts per lap")
+    ap.add_argument("--group", type=int, default=8,
+                    help="completions per prompt (GRPO group)")
+    ap.add_argument("--lr", type=float, default=2e-2)
+    ap.add_argument("--samples", type=int, default=7,
+                    help="samples per attempt (round-5 recipe)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--skip-perf", action="store_true")
+    ap.add_argument("--trace", default=None,
+                    help="dump a chrome trace of the run to this file")
+    args = ap.parse_args()
+
+    curve = bench_learning_curve(args)
+    extra = {"learning": curve}
+    secondary = [
+        {"metric": "rl_reward_last", "unit": "mean reward",
+         "value": curve["reward_last"]},
+        {"metric": "rl_strict_improve_updates", "unit": "updates",
+         "value": curve["strict_improve_updates"]},
+        {"metric": "rl_prefix_hit_ratio", "unit": "ratio",
+         "value": curve["prefix_hit_ratio"]},
+    ]
+    value = None
+    if not args.skip_perf:
+        perf = bench_perf(args)
+        extra["perf"] = perf
+        value = round(perf["rollout_tokens_per_sec"], 1)
+        secondary.append(
+            {"metric": "rl_weight_swap_seconds", "unit": "s",
+             "value": round(perf["swap_seconds"], 4)})
+    out = {
+        "metric": "rl_rollout_tokens_per_sec",
+        "value": value,
+        "unit": "tokens/s",
+        "secondary_metrics": secondary,
+        "extra": extra,
+    }
+    print(json.dumps(out))
+    with open("RL_BENCH.json", "w") as f:
+        json.dump(out, f, indent=2)
+    if args.trace:
+        from ray_tpu.util import tracing
+
+        tracing.dump(args.trace)
+        print(f"# wrote trace to {args.trace}")
+
+
+if __name__ == "__main__":
+    main()

@@ -8,6 +8,7 @@ from ray_tpu.devtools.rules import (  # noqa: F401
     async_blocking,
     discarded_future,
     except_hygiene,
+    global_guard,
     guarded_by,
     host_transfer,
     lock_order,

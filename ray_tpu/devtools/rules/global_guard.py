@@ -1,0 +1,175 @@
+"""GL010: mutation of a ``# guarded_by(<lock>)`` MODULE GLOBAL outside
+its lock.
+
+GL005 covers instance attributes; this rule covers the other shape the
+codebase actually uses: module-level registries/counters shared across
+threads (reward registries, handle caches, process-wide singletons). A
+module-level name whose defining assignment carries a
+``# guarded_by(<lock>)`` comment may only be mutated while an
+enclosing ``with <lock>:`` holds the named lock — the classic bug this
+catches is a global locked at most call sites but mutated bare in one
+(the inconsistency makes the locked sites useless).
+
+What counts as a mutation, from inside any function:
+
+- rebinding (``NAME = ...``, ``NAME += ...``, ``del NAME``) — only
+  when the function declares ``global NAME`` (otherwise the target is
+  a local that merely shadows the global);
+- item writes (``NAME[k] = ...``, ``del NAME[k]``) and mutating method
+  calls (``NAME.append(...)``, ``.pop``, ``.update`` …) — unless the
+  function binds ``NAME`` as a parameter or a plain local first.
+
+Module-level (import-time) mutations are exempt: imports happen-before
+sharing, same as ``__init__`` for GL005. The two caller-holds-the-lock
+conventions GL005 honors apply here too: a ``*_locked`` function-name
+suffix, and a docstring containing "holds <lock>".
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ray_tpu.devtools.context import ModuleContext
+from ray_tpu.devtools.registry import Rule, register
+
+_ANNOT_RE = re.compile(r"#.*?guarded_by\(\s*([\w\.]+)\s*\)")
+
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "remove",
+    "pop", "popleft", "popitem", "clear", "add", "discard", "update",
+    "setdefault", "rotate", "sort", "reverse",
+}
+
+
+def _root_name(node: ast.expr) -> str | None:
+    """The NAME in a NAME / NAME[k] / NAME.attr[k] chain, or None."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+@register
+class GlobalGuardedByRule(Rule):
+    name = "global-guarded-by"
+    code = "GL010"
+    description = ("guarded_by(<lock>)-annotated module global mutated "
+                   "outside a matching `with <lock>:` block")
+    invariant = ("annotated module-level shared state only mutates "
+                 "while its lock is held — locked at SOME sites and "
+                 "bare at others is the bug this exists for")
+    interests = ("Assign", "AnnAssign", "AugAssign", "Delete", "Call",
+                 "Global")
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        self._annotations: dict[str, str] = {}  # global -> lock qualname
+        self._global_decls: dict[int, set[str]] = {}  # fn id -> names
+        self._local_binds: dict[int, set[str]] = {}  # fn id -> names
+        self._events: list[tuple] = []
+        self._enabled = "guarded_by(" in ctx.source
+
+    # ---------------------------------------------------------------- visit
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if not self._enabled:
+            return
+        fn = ctx.current_function
+        if isinstance(node, ast.Global):
+            if fn is not None:
+                self._global_decls.setdefault(id(fn), set()).update(
+                    node.names)
+            return
+        if fn is None:
+            # module level: annotations are declared here, and
+            # import-time mutations happen-before sharing
+            if isinstance(node, (ast.Assign, ast.AnnAssign)) \
+                    and ctx.current_class is None:
+                self._maybe_annotation(node, ctx)
+            return
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            for target in self._targets(node):
+                if isinstance(target, ast.Name):
+                    # plain local bind unless `global` declared — track
+                    # both; end_module sorts out which it was
+                    self._local_binds.setdefault(id(fn), set()).add(
+                        target.id)
+                    self._record(target.id, "rebind", node, ctx)
+                else:
+                    name = _root_name(target)
+                    if name is not None:
+                        self._record(name, "item", node, ctx)
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    self._record(target.id, "rebind", node, ctx)
+                else:
+                    name = _root_name(target)
+                    if name is not None:
+                        self._record(name, "item", node, ctx)
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr in _MUTATORS):
+            name = _root_name(node.func.value)
+            if name is not None:
+                self._record(name, "item", node, ctx)
+
+    @staticmethod
+    def _targets(node) -> list[ast.expr]:
+        if isinstance(node, ast.Assign):
+            out = []
+            for t in node.targets:
+                out.extend(t.elts if isinstance(t, ast.Tuple) else [t])
+            return out
+        return [node.target]
+
+    def _maybe_annotation(self, node, ctx: ModuleContext) -> None:
+        line = ctx.lines[node.lineno - 1] if node.lineno <= len(ctx.lines) \
+            else ""
+        m = _ANNOT_RE.search(line)
+        if not m and node.lineno >= 2:
+            prev = ctx.lines[node.lineno - 2]
+            if prev.strip().startswith("#"):
+                m = _ANNOT_RE.search(prev)
+        if not m:
+            return
+        for target in self._targets(node):
+            if isinstance(target, ast.Name):
+                self._annotations[target.id] = m.group(1)
+
+    def _record(self, name: str, kind: str, node: ast.AST,
+                ctx: ModuleContext) -> None:
+        fns = tuple(ctx.func_stack)
+        docs = [(f.name, (ast.get_docstring(f, clean=False) or "").lower())
+                for f in fns]
+        self._events.append(
+            (name, kind, node, tuple(ctx.lock_stack), docs,
+             tuple(id(f) for f in fns),
+             tuple(a.arg for f in fns for a in
+                   f.args.args + f.args.posonlyargs + f.args.kwonlyargs)))
+
+    # ------------------------------------------------------------ end pass
+
+    def end_module(self, ctx: ModuleContext) -> None:
+        for name, kind, node, held, docs, fn_ids, params in self._events:
+            lock = self._annotations.get(name)
+            if lock is None:
+                continue
+            declared_global = any(
+                name in self._global_decls.get(i, ()) for i in fn_ids)
+            if kind == "rebind" and not declared_global:
+                continue  # a local that shadows the global
+            if kind == "item" and not declared_global and (
+                    name in params
+                    or any(name in self._local_binds.get(i, ())
+                           for i in fn_ids)):
+                continue  # parameter / plain local shadows the global
+            if lock in held:
+                continue
+            if any(fn_name.endswith("_locked")
+                   or f"holds {lock.lower()}" in doc
+                   for fn_name, doc in docs):
+                continue
+            fn_name = docs[-1][0] if docs else "?"
+            ctx.report(self, node,
+                       f"{name} is guarded_by({lock}) but {fn_name} "
+                       f"mutates it without holding the lock")

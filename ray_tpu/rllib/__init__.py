@@ -11,6 +11,12 @@ learner.py:109, torch DDP wrap replaced by GSPMD), prioritized replay
 recording, BC, MARWIL), multi-agent (multi_rl_module.py:49 +
 MultiAgentEnv), and nine algorithm families: PPO, APPO, IMPALA,
 DQN (+PER), SAC, CQL, DreamerV3, BC, MARWIL.
+
+RL for LLMs lives in the `ray_tpu.rllib.llm` subpackage (the
+serve.llm-engine-as-rollout-actor flywheel: GRPO learner, streamed
+trajectories, drain-free weight hot-swap — see RL.md). It is imported
+lazily: ``import ray_tpu.rllib.llm`` pulls in the serving stack, which
+plain env-RL users should not pay for.
 """
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig

@@ -19,6 +19,15 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+# Importing parallel.mesh forces jax_threefry_partitionable BEFORE any
+# learner inits params. Without this, a learner constructed before the
+# first build_mesh() call inits under legacy threefry and one
+# constructed after inits under partitionable threefry — different
+# random bits, so sharded-vs-single parity (the DDP guarantee
+# test_ppo_multi_learner_mesh_parity asserts) breaks at init, not in
+# the update. Same invariant family as graftlint GL003.
+import ray_tpu.parallel.mesh  # noqa: F401
+
 
 @dataclasses.dataclass
 class PPOLearnerConfig:

@@ -1,0 +1,50 @@
+"""ray_tpu.rllib.llm — the RL-for-LLMs flywheel.
+
+Closes the loop between the repo's two halves (RL.md has the full
+walkthrough):
+
+- **rollout** (`rollout.py`): the serve.llm continuous-batching engine
+  is the rollout actor — N completions per prompt share the task's
+  system prefix through the PR 4 prefix cache, per-token logprobs and
+  weight-version tags ride the stream, trajectory groups stream into
+  the object store as they finish;
+- **learn** (`learner.py`): a GRPO-style clipped policy-gradient
+  update, ONE jitted program over the train/ SPMD machinery
+  (make_train_step + the models' own forwards/partition rules), with a
+  staleness guard keyed on the weight-version tags;
+- **swap** (`flywheel.py` + serve.llm): the learner publishes params
+  through the object store and live replicas install them at an engine
+  step boundary — drain-free, no stream drops, in-flight sequences
+  tagged stale when they span versions.
+"""
+
+from ray_tpu.rllib.llm.flywheel import FlywheelConfig, RLFlywheel
+from ray_tpu.rllib.llm.learner import LLMLearner, LLMLearnerConfig
+from ray_tpu.rllib.llm.reward import (
+    DigitSumTask,
+    SortTask,
+    get_reward,
+    register_reward,
+)
+from ray_tpu.rllib.llm.rollout import RolloutConfig, RolloutWorker
+from ray_tpu.rllib.llm.trajectory import (
+    Trajectory,
+    group_relative_advantages,
+    to_train_batch,
+)
+
+__all__ = [
+    "DigitSumTask",
+    "FlywheelConfig",
+    "LLMLearner",
+    "LLMLearnerConfig",
+    "RLFlywheel",
+    "RolloutConfig",
+    "RolloutWorker",
+    "SortTask",
+    "Trajectory",
+    "get_reward",
+    "group_relative_advantages",
+    "register_reward",
+    "to_train_batch",
+]

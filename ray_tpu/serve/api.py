@@ -607,6 +607,62 @@ class DeploymentHandle:
 
         return call
 
+    def update_weights(self, version: int, weights,
+                       timeout: float = 120.0) -> list[dict]:
+        """Broadcast a drain-free weight hot-swap to EVERY replica of
+        this app (the RL flywheel's learner->serving edge). `weights`
+        is a param pytree, an ObjectRef to one (publish once via
+        `ray_tpu.put`, every replica pulls through the object store),
+        or a list of pytree-chunk refs. Rides the replicas' "control"
+        concurrency group so the swap never queues behind in-flight
+        token streams; each replica installs at its own engine-step
+        boundary (no stream drops — see LLMEngine.update_weights for
+        the version/staleness contract).
+
+        Returns one dict per replica: swap stats on success,
+        ``{"version": v, "already_installed": True, ...}`` when the
+        replica rejected a duplicate version (it is already AT or past
+        `version` — a retry after a lost reply lands here, which is
+        convergence, not failure), or ``{"version": v, "error":
+        "<repr>"}`` for a real failure — per-replica outcomes are
+        never collapsed into one exception, because a partial failure
+        leaves the fleet version-split and the caller needs to know
+        WHICH replicas installed. Raises only when every replica
+        genuinely failed. `timeout` is ONE shared deadline across the
+        whole broadcast, not per replica."""
+        import time as _t
+
+        import ray_tpu
+
+        self._maybe_refresh()
+        with self._lock:
+            replicas = list(self._replicas)
+        refs = [
+            r.handle_request.options(concurrency_group="control").remote(
+                "update_weights", (version, weights), {})
+            for r in replicas]
+        deadline = _t.monotonic() + timeout
+        out, failures = [], 0
+        for ref in refs:
+            try:
+                out.append(ray_tpu.get(
+                    ref, timeout=max(0.01, deadline - _t.monotonic())))
+            except Exception as e:  # noqa: BLE001
+                if "weight version must increase" in str(e):
+                    # duplicate-version rejection: this replica already
+                    # installed `version` (or newer) — convergence
+                    out.append({"version": version,
+                                "already_installed": True,
+                                "error": repr(e)})
+                else:
+                    failures += 1
+                    out.append({"version": version, "error": repr(e)})
+        if out and failures == len(out):
+            raise RuntimeError(
+                f"weight swap to version {version} failed on every "
+                f"replica of {self.app_name!r}: {out}")
+        return out
+
     def affinity_key_for(self, payload) -> str | None:
         """Routing key the proxy should use for `payload` — None unless
         this app opted in via Deployment(payload_affinity=True)."""

@@ -24,7 +24,13 @@ The serving counterpart of ray.serve's LLM stack, built jax-first:
   queue depth, cache utilization) through `ray_tpu.util.metrics`;
 - a **serve deployment** (`deployment.py`): `@serve.deployment`
   replicas each own one engine plus its step-loop thread, and
-  `DeploymentHandle.options(stream=True)` streams tokens back.
+  `DeploymentHandle.options(stream=True)` streams tokens back;
+- **versioned weight hot-swap** (RL flywheel, RL.md):
+  `LLMEngine.update_weights` / `DeploymentHandle.update_weights`
+  install new params at an engine step boundary — drain-free, token
+  streams tagged per-token with the weight version, prefix cache
+  invalidated — and `SamplingParams(logprobs=True)` makes streams
+  carry the per-token log-probs RL learners consume.
 
 See SERVING.md for the architecture walkthrough.
 """

@@ -224,6 +224,24 @@ class BlockPool:
                 out.append(page)
         return out
 
+    def invalidate_prefix_cache(self) -> int:
+        """Drop EVERY content registration (weight hot-swap): cached KV
+        was computed under the old weights, so a post-swap admission
+        matching it would silently mix weight versions inside one
+        forward. Parked refcount-0 pages return to the free list;
+        in-use pages stay allocated (their owners keep decoding, tagged
+        stale by the engine) but lose their registration so no future
+        request can match them. Returns the number of registrations
+        dropped."""
+        with self._lock:
+            n = len(self._hash_of)
+            for page in self._lru:
+                self._free.append(page)
+            self._lru.clear()
+            self._hash_of.clear()
+            self._page_of.clear()
+            return n
+
     def refcount(self, page: int) -> int:
         with self._lock:
             return self._refcount.get(page, 0)

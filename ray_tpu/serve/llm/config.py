@@ -23,6 +23,13 @@ class SamplingParams:
     eos_token_id: int | Sequence[int] | None = None
     # include prompt token ids in the final output event (debug aid)
     echo: bool = False
+    # emit the sampled token's log-probability per token event and a
+    # "logprobs" list in the final event. The value is log-softmax of
+    # the model logits at the sampled token, scaled by `temperature`
+    # when temperature > 0 (i.e. the log-prob under the distribution
+    # actually sampled from, BEFORE top-k/top-p truncation — RL rollout
+    # consumers run without truncation so behaviour == policy).
+    logprobs: bool = False
 
     def __post_init__(self):
         if self.max_tokens < 1:
@@ -53,7 +60,8 @@ class SamplingParams:
             top_k=int(d.get("top_k", 0)),
             top_p=float(d.get("top_p", 1.0)),
             eos_token_id=d.get("eos_token_id"),
-            echo=bool(d.get("echo", False)))
+            echo=bool(d.get("echo", False)),
+            logprobs=bool(d.get("logprobs", False)))
 
 
 @dataclasses.dataclass

@@ -102,6 +102,29 @@ class LLMServer:
             if stream.final() is None:
                 self.engine.abort_request(stream, "client_disconnected")
 
+    def update_weights(self, version: int, weights) -> dict:
+        """Install new engine params (weight hot-swap). `weights` is a
+        param pytree, an ObjectRef to one (the learner publishes params
+        through the object store; the runtime resolves refs passed as
+        actor-call args, and this also resolves one passed inside),
+        or a list of refs whose values are pytree chunks to merge.
+        Drain-free: in-flight token streams keep running — see
+        `LLMEngine.update_weights` for the version/staleness
+        contract."""
+        import ray_tpu
+        from ray_tpu.core.api import ObjectRef
+
+        if isinstance(weights, ObjectRef):
+            weights = ray_tpu.get(weights)
+        elif (isinstance(weights, (list, tuple)) and weights
+              and all(isinstance(w, ObjectRef) for w in weights)):
+            parts = ray_tpu.get(list(weights))
+            merged: dict = {}
+            for p in parts:
+                merged.update(p)
+            weights = merged
+        return self.engine.update_weights(version, weights)
+
     def engine_stats(self) -> dict:
         return self.engine.stats()
 

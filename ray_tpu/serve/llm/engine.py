@@ -173,6 +173,10 @@ class LLMEngine:
         self._lock = threading.Lock()
         self._step_lock = threading.Lock()
         self._tokens_window: list[tuple[float, int]] = []  # (t, n)
+        # weight hot-swap state: bumped only by update_weights(), which
+        # holds _step_lock — so within one step() every sampled token
+        # sees ONE version (no mid-decode-step version mix)
+        self._weight_version = 0  # guarded_by(_step_lock)
         self._build_metrics()
 
     # ----------------------------------------------------------- metrics
@@ -233,6 +237,16 @@ class LLMEngine:
             "Decode stall imposed by a prefill step that ran while "
             "decode-ready lanes were waiting",
             boundaries=(1, 5, 10, 25, 50, 100, 250, 500, 1000),
+            tag_keys=tags)
+        self._m_swaps = Counter(
+            "serve_llm_weight_swaps_total",
+            "Weight hot-swaps installed at a step boundary",
+            tag_keys=tags)
+        self._m_swap_s = Histogram(
+            "rl_weight_swap_seconds",
+            "Wall time of a drain-free weight hot-swap (params install "
+            "+ prefix-cache invalidation), streams in flight",
+            boundaries=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10),
             tag_keys=tags)
         # counter deltas are computed against the last pump
         self._last_prefix = (0, 0, 0)
@@ -356,15 +370,16 @@ class LLMEngine:
     def _do_prefill(self, work: PrefillWork) -> None:
         seq = work.seq
         sp = seq.sampling
+        ver = self._weight_version  # stable: step holds _step_lock
         tokens = seq.refill_tokens[work.start:work.end]
         try:
             if work.start == 0 and work.is_last:
                 # whole prompt in one go and nothing cached: the
                 # monolithic program skips the context gather
-                nxt, _ = self.runner.prefill(
+                nxt, last = self.runner.prefill(
                     tokens, seq.table, sp.temperature, sp.top_k, sp.top_p)
             else:
-                nxt, _ = self.runner.prefill_chunk(
+                nxt, last = self.runner.prefill_chunk(
                     tokens, work.start, seq.table, sp.temperature,
                     sp.top_k, sp.top_p)
         except Exception as e:  # noqa: BLE001
@@ -384,9 +399,12 @@ class LLMEngine:
             self._m_ttft.observe(
                 (time.monotonic() - seq.enqueued_at) * 1e3,
                 tags=self._m_tags)
+        if sp.logprobs:
+            seq.logprobs.append(self._logprob_of(last, nxt, sp.temperature))
         with self._lock:
+            seq.token_versions.append(ver)
             done = self.scheduler.commit_token(seq, nxt)
-        self._emit_token(seq, nxt)
+        self._emit_token(seq, nxt, ver)
         self._note_tokens(1)
         if done:
             self._finalize(seq)
@@ -395,11 +413,12 @@ class LLMEngine:
         # the lane feeds generated[-1], which LIVES at absolute position
         # pos-1 (it was sampled but never cached): rope/wpe index, the
         # context mask, and the KV scatter all key off that position
+        ver = self._weight_version  # stable: step holds _step_lock
         items = [DecodeItem(s.last_token, s.pos - 1, s.table,
                             s.sampling.temperature, s.sampling.top_k,
                             s.sampling.top_p) for s in work.seqs]
         try:
-            next_tokens, _ = self.runner.decode(items)
+            next_tokens, logits = self.runner.decode(items)
         except Exception as e:  # noqa: BLE001
             with self._lock:
                 for s in work.seqs:
@@ -407,25 +426,45 @@ class LLMEngine:
             for s in work.seqs:
                 self._finalize(s)
             return
+        for i, (s, tok) in enumerate(zip(work.seqs, next_tokens)):
+            if s.sampling.logprobs:
+                s.logprobs.append(self._logprob_of(
+                    logits[i], tok, s.sampling.temperature))
         finished = []
         with self._lock:
             for s, tok in zip(work.seqs, next_tokens):
+                s.token_versions.append(ver)
                 if self.scheduler.commit_token(s, tok):
                     finished.append(s)
         for s, tok in zip(work.seqs, next_tokens):
-            self._emit_token(s, tok)
+            self._emit_token(s, tok, ver)
         self._note_tokens(len(next_tokens))
         for s in finished:
             self._finalize(s)
 
     # ------------------------------------------------------------ output
 
-    def _emit_token(self, seq: Sequence, token: int) -> None:
+    def _logprob_of(self, logits, token: int, temperature: float) -> float:
+        """See runner.logprob_at — the ONE logprob definition shared
+        with the RL learner's teacher-forced reference."""
+        from ray_tpu.serve.llm.runner import logprob_at
+
+        return logprob_at(logits, token, temperature,
+                          self.model_cfg.vocab_size)
+
+    def _emit_token(self, seq: Sequence, token: int,
+                    version: int) -> None:
+        """`version` is the step-stable weight version the caller read
+        under `_step_lock` — required, so a token can never be tagged
+        from a concurrent swap's half-installed state."""
         with self._lock:
             stream = self._streams.get(seq.seq_id)
         if stream is not None:
-            stream._emit({"token": int(token),
-                          "index": len(seq.generated) - 1})
+            ev = {"token": int(token), "index": len(seq.generated) - 1}
+            if seq.sampling.logprobs:
+                ev["logprob"] = seq.logprobs[-1]
+                ev["weight_version"] = version
+            stream._emit(ev)
 
     def _finalize(self, seq: Sequence) -> None:
         with self._lock:
@@ -435,6 +474,7 @@ class LLMEngine:
         outcome = (seq.finish_reason or "unknown").split(":", 1)[0]
         self._m_requests.inc(
             tags={"model": self.config.model, "outcome": outcome})
+        versions = sorted(set(seq.token_versions))
         final = {
             "done": True,
             "finish_reason": seq.finish_reason,
@@ -444,12 +484,74 @@ class LLMEngine:
             # prompt tokens served from the prefix cache at the last
             # admission (vLLM/OpenAI `cached_tokens` usage field)
             "cached_tokens": seq.cached_tokens,
+            # weight-version contract (RL.md): `weight_version` is the
+            # version the stream finished on; `stale` means the tokens
+            # (or the KV they were decoded against) span more than one
+            # version, so logprobs are NOT reproducible by a teacher-
+            # forced forward at any single version
+            "weight_version": (versions[-1] if versions
+                               else self._weight_version),
+            "weight_versions": versions,
+            "stale": seq.kv_stale or len(versions) > 1,
         }
         if seq.sampling.echo:
             final["prompt_token_ids"] = list(seq.prompt)
+        if seq.sampling.logprobs:
+            final["logprobs"] = list(seq.logprobs)
         stream._close(final)
 
     # ------------------------------------------------------------- admin
+
+    @property
+    def weight_version(self) -> int:
+        return self._weight_version
+
+    def update_weights(self, version: int, params: Any) -> dict:
+        """Drain-free weight hot-swap, installed at a step boundary.
+
+        Taking `_step_lock` means no device program is in flight: the
+        swap slots cleanly BETWEEN engine steps, so every token sampled
+        by one decode step carries one weight version — in-flight
+        streams are never dropped, they simply continue on the new
+        weights. Semantics (documented in RL.md, test-gated):
+
+        - tokens already sampled keep their old version tags; tokens
+          sampled after the swap are tagged `version`;
+        - running sequences keep their old-version KV pages and decode
+          new tokens against them with the new weights — their final
+          event is tagged ``stale`` (mixed versions, logprobs not
+          reproducible at any single version);
+        - the prefix cache is invalidated (old-weight KV must never be
+          matched by a post-swap admission) and stale sequences stop
+          registering pages;
+        - `version` must be strictly increasing.
+
+        Returns swap stats (previous version, wall time, in-flight
+        stream count, registrations dropped)."""
+        from ray_tpu.util import tracing
+
+        t0 = time.perf_counter()
+        with self._step_lock:
+            if version <= self._weight_version:
+                raise ValueError(
+                    f"weight version must increase: engine at "
+                    f"{self._weight_version}, got {version}")
+            with tracing.span("rl.weight_swap"):
+                self.runner.set_params(params)
+                dropped = self.pool.invalidate_prefix_cache()
+                with self._lock:
+                    previous = self._weight_version
+                    self._weight_version = version
+                    running = list(self.scheduler.running)
+                    for s in running:
+                        s.kv_stale = True
+                    in_flight = len(running) + len(self.scheduler.waiting)
+        dt = time.perf_counter() - t0
+        self._m_swaps.inc(tags=self._m_tags)
+        self._m_swap_s.observe(dt, tags=self._m_tags)
+        return {"version": version, "previous_version": previous,
+                "swap_seconds": dt, "in_flight_streams": in_flight,
+                "registrations_dropped": dropped}
 
     def warmup(self) -> int:
         """Precompile every bucketed program (prefill lengths x decode
@@ -470,6 +572,7 @@ class LLMEngine:
             "max_batch_size": self.config.max_batch_size,
             "max_model_len": self.runner.max_model_len,
             "compiled_programs": self.runner.compiled_signatures(),
+            "weight_version": self._weight_version,
         })
         return d
 

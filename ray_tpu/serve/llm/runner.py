@@ -114,6 +114,24 @@ def _next_pow2(n: int, lo: int) -> int:
     return b
 
 
+def logprob_at(logits, token: int, temperature: float,
+               vocab_size: int) -> float:
+    """Log-prob of `token` under the distribution it was sampled from:
+    log-softmax over the real vocab (padding masked) of `logits`
+    (one position's row), scaled by temperature when temperature > 0
+    (greedy reports the unscaled policy log-prob). Host-side float64.
+
+    This is THE logprob definition of the RL determinism contract
+    (RL.md): the engine records rollout logprobs with it and the GRPO
+    learner's teacher-forced reference recomputes them with it — one
+    implementation, so the two cannot drift."""
+    x = np.asarray(logits, np.float64)[:vocab_size]
+    if temperature > 0:
+        x = x / temperature
+    x = x - x.max()
+    return float(x[int(token)] - np.log(np.exp(x).sum()))
+
+
 class ModelRunner:
     """Executes prefill/decode for one model instance. Not thread-safe:
     exactly one step-loop thread drives it (the engine enforces this);
@@ -493,6 +511,40 @@ class ModelRunner:
                 break
             s = min(s * 2, self.max_batch_size)
         return self.compiled_signatures()
+
+    def set_params(self, params: Any) -> None:
+        """Install a new parameter pytree (weight hot-swap). The tree
+        structure and leaf shapes must match the resident params, and
+        leaves are cast to the resident dtypes, so a swap can NEVER
+        trigger a recompile — the compiled programs see new argument
+        values, not new signatures. With a mesh, leaves are re-sharded
+        through the same partition rules as construction. The caller
+        guarantees no device program is in flight (the engine holds its
+        step lock across the swap); `_jit_lock` is still taken so a
+        concurrent stats probe cannot observe a half-installed tree."""
+        old_struct = jax.tree_util.tree_structure(self.params)
+        new_struct = jax.tree_util.tree_structure(params)
+        if old_struct != new_struct:
+            raise ValueError(
+                f"param tree mismatch: engine has {old_struct}, "
+                f"update has {new_struct}")
+
+        def cast(new, old):
+            arr = jnp.asarray(new, dtype=old.dtype)
+            if arr.shape != old.shape:
+                raise ValueError(
+                    f"param shape mismatch: engine has {old.shape}, "
+                    f"update has {arr.shape}")
+            return arr
+
+        params = jax.tree.map(cast, params, self.params)
+        if self.mesh is not None:
+            from ray_tpu.parallel.sharding import shard_pytree
+
+            params = shard_pytree(params, self.adapter.rules_fn(),
+                                  self.mesh)
+        with self._jit_lock:
+            self.params = params
 
     def reset_cache(self) -> None:
         """Zero the pages (tests); allocator state lives in BlockPool."""

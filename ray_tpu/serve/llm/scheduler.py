@@ -87,6 +87,19 @@ class Sequence:
     # and how many leading pages of `table` are content-registered
     cached_tokens: int = 0
     registered_pages: int = 0
+    # weight hot-swap bookkeeping (RL flywheel): the engine's weight
+    # version at the step that sampled each generated token, and —
+    # when SamplingParams.logprobs — the sampled token's log-prob under
+    # the distribution it was drawn from. Both survive preemption
+    # (recompute replays the tokens, it does not resample them).
+    token_versions: list[int] = dataclasses.field(default_factory=list)
+    logprobs: list[float] = dataclasses.field(default_factory=list)
+    # set by the engine on running sequences at a weight swap: this
+    # sequence's KV pages mix weight versions, so they must never be
+    # content-registered (a later match would reuse stale KV) and the
+    # trajectory is tagged stale. Cleared on preemption — recompute
+    # rebuilds the whole table under one consistent version.
+    kv_stale: bool = False
     enqueued_at: float = dataclasses.field(default_factory=time.monotonic)
     first_token_at: float | None = None
     finish_reason: str | None = None
@@ -296,6 +309,7 @@ class Scheduler:
         seq.prefill_target = 0
         seq.cached_tokens = 0
         seq.registered_pages = 0
+        seq.kv_stale = False  # re-prefill rebuilds KV on one version
         seq.state = SeqState.WAITING
         seq.preemptions += 1
         self.preemption_count += 1
@@ -330,7 +344,7 @@ class Scheduler:
         completely written (positions 0..upto_tokens-1). Idempotent via
         seq.registered_pages."""
         if not self.pool.enable_prefix_cache \
-                or seq.state is SeqState.FINISHED:
+                or seq.state is SeqState.FINISHED or seq.kv_stale:
             return
         bs = self.pool.block_size
         full = min(upto_tokens // bs, len(seq.table))

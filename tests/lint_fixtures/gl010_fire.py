@@ -1,0 +1,28 @@
+"""GL010 fire fixture: module globals annotated guarded_by(<lock>) but
+mutated bare at some sites (locked at others — the inconsistency that
+makes the locked sites useless)."""
+
+import threading
+
+_LOCK = threading.Lock()
+_TABLE = {}  # guarded_by(_LOCK)
+# guarded_by(_LOCK)
+_COUNT = 0
+
+
+def locked_site(k, v):
+    with _LOCK:
+        _TABLE[k] = v
+
+
+def bare_item_write(k, v):
+    _TABLE[k] = v  # fires: same global, no lock
+
+
+def bare_mutator_call(k):
+    _TABLE.pop(k, None)  # fires
+
+
+def bare_rebind():
+    global _COUNT
+    _COUNT += 1  # fires

@@ -1,0 +1,47 @@
+"""GL010 clean twin: every mutation of an annotated module global
+holds the lock, uses a caller-holds convention, or is not actually the
+global at all."""
+
+import threading
+
+_LOCK = threading.Lock()
+_TABLE = {}  # guarded_by(_LOCK)
+_COUNT = 0  # guarded_by(_LOCK)
+_PLAIN = {}  # unannotated: not checked
+
+_TABLE["boot"] = 1  # import time: happens-before sharing
+
+
+def locked_sites(k, v):
+    with _LOCK:
+        _TABLE[k] = v
+        _TABLE.pop(k, None)
+
+
+def locked_rebind():
+    global _COUNT
+    with _LOCK:
+        _COUNT += 1
+
+
+def _flush_locked():
+    _TABLE.clear()  # *_locked suffix: caller holds the lock
+
+
+def documented_helper():
+    """caller holds _lock... specifically holds _LOCK."""
+    _TABLE.update({})
+
+
+def local_shadow():
+    _TABLE = {}  # a LOCAL, not the module global
+    _TABLE["x"] = 1
+    return _TABLE
+
+
+def shadowing_param(_TABLE):
+    _TABLE["x"] = 1  # parameter, not the module global
+
+
+def unannotated(k):
+    _PLAIN[k] = 1
