@@ -100,12 +100,17 @@ def _time_steps(step, state, batch, mesh, warmup: int, steps: int):
     on every PJRT plugin. Returns (state, final_loss, seconds)."""
     import time as _time
 
+    from ray_tpu.train import spmd
+
     # at least one warmup step: it also binds `metrics` for the sync read
     warmup = max(1, warmup)
     with mesh:
         for _ in range(warmup):
             state, metrics = step(state, batch)
         float(metrics["loss"])
+        # attribution runs (--trace): the table covers the TIMED steps
+        # only, so phase totals compare against `dt` directly
+        spmd.waterfall.reset()
         t0 = _time.perf_counter()
         for _ in range(steps):
             state, metrics = step(state, batch)
@@ -134,6 +139,14 @@ def main(trace: str | None = None):
         init_sharded_state,
         make_train_step,
     )
+
+    from ray_tpu.train import spmd
+
+    if trace:
+        # --trace turns the bench into a profiling run: per-step phase
+        # attribution on (adds a device sync per step — the recorded
+        # headline numbers come from runs WITHOUT --trace)
+        spmd.enable_step_waterfall()
 
     devices = jax.devices()
     n = len(devices)
@@ -170,6 +183,10 @@ def main(trace: str | None = None):
     with tracing.span("bench.gpt2", category="bench"):
         state, final_loss, dt = _time_steps(step, state, batch, mesh,
                                             warmup, steps)
+    # per-phase attribution of the timed gpt2 steps (--trace runs):
+    # phases sum to ~dt, so the percents decompose the MFU number
+    attribution = spmd.waterfall.summary() if trace else None
+    attribution_table = spmd.waterfall.table() if trace else None
 
     tokens_per_sec = B * seq * steps / dt
     per_chip = tokens_per_sec / n
@@ -284,11 +301,16 @@ def main(trace: str | None = None):
                         round(ppo.get("stdev", 0.0), 1),
                     "ppo_env_steps_per_sec_max":
                         round(ppo.get("max", 0.0)),
+                    "step_attribution": attribution,
                 },
             }
         )
     )
     if trace:
+        # the attribution table: where the headline gpt2 step time went
+        # (phases sum to ~the measured step time — the waterfall
+        # contract tests pin)
+        print(attribution_table, flush=True)
         # bench runs double as profiling runs: the compile spans +
         # bench phase spans land in a chrome trace next to the numbers
         tracing.dump(trace)

@@ -97,6 +97,15 @@ def _rate(fn, n):
 def main():
     quick = "--quick" in sys.argv
     scale = 0.2 if quick else 1.0
+    # --trace out.json: dump a chrome trace of the run (task/actor/user
+    # spans) — every bench driver doubles as a profiling run
+    trace = None
+    if "--trace" in sys.argv:
+        i = sys.argv.index("--trace")
+        if i + 1 >= len(sys.argv):
+            print("error: --trace needs a filename", file=sys.stderr)
+            sys.exit(2)
+        trace = sys.argv[i + 1]
 
     def N(n):
         return max(10, int(n * scale))
@@ -201,6 +210,12 @@ def main():
     }
     with open("CORE_BENCH.json", "w") as f:
         json.dump(report, f, indent=1)
+    if trace:
+        from ray_tpu.util import tracing
+
+        # dump BEFORE shutdown: the merged timeline needs the runtime
+        tracing.dump(trace)
+        print(f"# wrote trace to {trace}")
     ray_tpu.shutdown()
 
 

@@ -46,7 +46,11 @@ class _ActorRecord:
 
 
 class Head:
-    def __init__(self, session_name: str = "session", storage=None):
+    def __init__(self, session_name: str = "session", storage=None,
+                 span_capacity: int = 50_000,
+                 span_spill_dir: str | None = None,
+                 span_spill_max_bytes: int = 64 << 20,
+                 span_rate_limit: float | None = None):
         from ray_tpu.core.head_storage import InMemoryHeadStore
 
         self.server = RpcServer(name="head", num_threads=32)
@@ -71,8 +75,32 @@ class Head:
         self._task_events = _dq(maxlen=10000)
         # raw span buffer for the merged cluster timeline: workers and
         # drivers flush their TaskEventLogs here over the task_events
-        # oneway channel (reference: TaskEventBuffer -> GcsTaskManager)
-        self._span_events = _dq(maxlen=50000)
+        # oneway channel (reference: TaskEventBuffer -> GcsTaskManager).
+        # Overflow beyond span_capacity SPILLS to bounded on-disk JSONL
+        # (oldest first) instead of vanishing; dump_timeline merges the
+        # spill back in, so the timeline window is disk-bounded, not
+        # 50k-spans-bounded.
+        self._span_events = _dq()
+        self._span_capacity = span_capacity
+        from ray_tpu.utils.events import SpanSpill
+
+        self._span_spill = SpanSpill(span_spill_dir, span_spill_max_bytes)
+        # span-policy plane (head-driven sampling for >10k spans/s):
+        # operator policy wins; otherwise an automatic per-producer rate
+        # limit kicks in when cluster-wide inflow exceeds the cap
+        import os as _os
+
+        self._span_rate_limit = float(
+            span_rate_limit if span_rate_limit is not None
+            else _os.environ.get("RAY_TPU_SPAN_RATE_LIMIT", 10_000.0))
+        self._span_policy: dict | None = None  # guarded_by(_lock)
+        self._span_inflow = _dq()  # (monotonic, n) — guarded_by(_lock)
+        self._span_producers: dict[str, float] = {}  # guarded_by(_lock)
+        # hysteresis for automatic mode: once engaged, the limit stays
+        # until inflow drops well below the cap — the head observes
+        # POST-sampling inflow, so releasing at the cap would oscillate
+        # (throttle -> inflow falls -> release -> flood -> repeat)
+        self._span_auto_engaged = False  # guarded_by(_lock)
         # long-poll subscriber mailboxes: sub_id -> {topics, queue, cond}
         self._poll_subs: dict = {}
         self._queue_lens: dict[bytes, int] = {}  # pending tasks per node
@@ -109,6 +137,7 @@ class Head:
         s.register("list_actors", self._h_list_actors)
         s.register("task_event", self._h_task_event, oneway=True)
         s.register("task_events", self._h_task_events, oneway=True)
+        s.register("span_policy", self._h_span_policy)
         s.register("list_tasks", self._h_list_tasks)
         # big payload / fan-out surfaces ride the slow lane so a timeline
         # dump or metrics scrape never starves heartbeats
@@ -517,6 +546,35 @@ class Head:
         with self._lock:
             self._task_events.append(msg)
 
+    def _ingest_spans(self, spans) -> None:
+        """Append flushed spans to the bounded in-memory window, spilling
+        the overflow (oldest first) to disk. The spill write happens
+        OUTSIDE the head lock — disk latency must never stall heartbeat
+        or ingest handlers."""
+        if not spans:
+            return
+        now = time.monotonic()
+        overflow: list = []
+        with self._lock:
+            self._span_events.extend(spans)
+            while len(self._span_events) > self._span_capacity:
+                overflow.append(self._span_events.popleft())
+            # inflow accounting for the auto rate-limit policy
+            self._span_inflow.append((now, len(spans)))
+            while self._span_inflow and self._span_inflow[0][0] < now - 10:
+                self._span_inflow.popleft()
+            for s in spans:
+                proc = s.get("proc")
+                if proc:
+                    self._span_producers[proc] = now
+                    break  # one batch = one producer
+            if len(self._span_producers) > 512:
+                self._span_producers = {
+                    p: t for p, t in self._span_producers.items()
+                    if t > now - 60}
+        if overflow:
+            self._span_spill.append(overflow)
+
     def _h_task_events(self, msg, frames):
         """Batched variant (workers buffer events; reference:
         task_event_buffer.h periodic flush). Also the span-flush channel:
@@ -524,7 +582,37 @@ class Head:
         cluster timeline."""
         with self._lock:
             self._task_events.extend(msg.get("events", ()))
-            self._span_events.extend(msg.get("spans", ()))
+        self._ingest_spans(msg.get("spans", ()))
+
+    def set_span_policy(self, policy: dict | None) -> None:
+        """Operator-set span sampling policy, served to every producer
+        via the `span_policy` RPC (``{"max_per_s": N, "categories":
+        {cat: N}}``, 0/absent = unlimited). None reverts to automatic
+        mode: unlimited until cluster inflow crosses the head's rate
+        cap, then a per-producer share of the cap."""
+        with self._lock:
+            self._span_policy = dict(policy) if policy else None
+
+    def _h_span_policy(self, msg, frames):
+        now = time.monotonic()
+        with self._lock:
+            if self._span_policy is not None:
+                return {"policy": self._span_policy}
+            inflow = sum(n for t, n in self._span_inflow
+                         if t > now - 10) / 10.0
+            producers = sum(1 for t in self._span_producers.values()
+                            if t > now - 30)
+            if inflow > self._span_rate_limit:
+                self._span_auto_engaged = True
+            elif inflow < self._span_rate_limit / 4:
+                # release only when POST-sampling inflow sits far below
+                # the cap: at the cap itself the throttle is what is
+                # holding inflow down, and releasing would flood again
+                self._span_auto_engaged = False
+            if not self._span_auto_engaged:
+                return {"policy": None}
+            per_producer = self._span_rate_limit / max(1, producers)
+            return {"policy": {"max_per_s": per_producer}}
 
     def _h_list_tasks(self, msg, frames):
         limit = int(msg.get("limit", 1000))
@@ -537,12 +625,15 @@ class Head:
         the GCS task events). The caller's own just-drained spans ride
         in the request and are appended first, so a one-shot dump always
         includes them (no oneway/call ordering to rely on). Non-draining
-        otherwise: repeated dumps see history up to the buffer cap."""
-        limit = int(msg.get("limit", 50000))
+        otherwise: repeated dumps see history up to the in-memory cap
+        PLUS whatever the bounded on-disk spill still holds — spilled
+        spans merge back transparently."""
+        limit = int(msg.get("limit", 200_000))
+        self._ingest_spans(msg.get("spans", ()))
+        spilled = self._span_spill.read()
         with self._lock:
-            self._span_events.extend(msg.get("spans", ()))
-            spans = list(self._span_events)[-limit:]
-        return {"spans": spans}
+            spans = spilled + list(self._span_events)
+        return {"spans": spans[-limit:]}
 
     # ------------------------------------------------------------ metrics
 

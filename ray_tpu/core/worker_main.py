@@ -293,9 +293,34 @@ class WorkerRuntime(ClusterRuntime):
             self._flush_failures = 0
 
     def _event_flush_loop(self):
+        beat = 0
         while True:
             time.sleep(1.0)
             self._flush_task_events()
+            # off the record() hot path: publish span kept/dropped
+            # deltas into this worker's /metrics page once a second
+            self._events.sync_metrics()
+            beat += 1
+            if beat % 5 == 0:
+                self._refresh_span_policy()
+
+    def _refresh_span_policy(self):
+        """Adopt the head's span sampling policy (head-driven rate
+        limits: one knob at the head throttles every producer when
+        cluster span inflow crosses the cap). Best-effort — a dead head
+        just leaves the current policy in place. Reinstall only on
+        CHANGE: installing a policy resets token buckets and the
+        first-seen set, so re-pushing an identical policy every poll
+        would quietly defeat both."""
+        try:
+            r = self.client.call(self.head_address, "span_policy", {},
+                                 timeout=2)
+            policy = r.get("policy")
+            if policy != getattr(self, "_last_span_policy", None):
+                self._last_span_policy = policy
+                self._events.configure_sampling(policy)
+        except Exception:  # noqa: BLE001
+            pass
 
     def _h_execute_task(self, msg, frames):
         self._exec_task_spec(TaskSpec(**msg["spec"]), notify_nodelet=True)

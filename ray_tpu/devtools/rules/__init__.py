@@ -12,6 +12,7 @@ from ray_tpu.devtools.rules import (  # noqa: F401
     guarded_by,
     host_transfer,
     lock_order,
+    oneway_raise,
     oneway_return,
     spmd_nondeterminism,
     store_refcount,

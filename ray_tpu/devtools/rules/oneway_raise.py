@@ -1,0 +1,112 @@
+"""GL011: exceptions escaping a oneway RPC handler are swallowed.
+
+A handler registered with ``register(<method>, fn, oneway=True)`` has
+no reply path, and the dispatch loop in ``ray_tpu/core/rpc.py``
+deliberately sends nothing back on error — an exception that escapes a
+oneway handler simply vanishes. A ``raise`` (or ``assert``) in one is
+therefore a silent no-op masquerading as validation: the author
+believed *someone* observes the failure, but neither the caller (fired
+and forgot) nor the server (dispatch drops it) ever does. The bug
+class GL008 catches for return values, this rule catches for errors.
+
+Heuristic: reuse GL008's oneway-registration detection (``<anything>
+.register(<name>, <handler>, oneway=True)``, keyword or third
+positional), then flag every ``raise``/``assert`` in the same-module
+function of that name that can ESCAPE the handler — i.e. one not
+enclosed in a ``try`` with at least one ``except`` clause inside the
+handler itself (any handler counts; matching exception types is out of
+AST reach and a deliberately-narrow except around a raise is already a
+considered choice). Statements inside functions NESTED in the handler
+belong to the nested function and are ignored, as are re-raises inside
+``except`` bodies only when a further enclosing try covers them —
+an uncovered bare ``raise`` in an except clause escapes too.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools.context import ModuleContext
+from ray_tpu.devtools.registry import Rule, register
+from ray_tpu.devtools.rules.oneway_return import _handler_name, _is_true
+
+
+def _escaping_raises(fn: ast.AST) -> list[ast.AST]:
+    """Raise/Assert nodes in `fn`'s OWN body that no enclosing
+    try/except (within `fn`) can catch."""
+    out: list[ast.AST] = []
+
+    def scan(node: ast.AST, caught: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return  # nested scope: its raises are its own business
+        if isinstance(node, (ast.Raise, ast.Assert)):
+            if not caught:
+                out.append(node)
+            return
+        if isinstance(node, ast.Try):
+            covered = caught or bool(node.handlers)
+            for st in node.body:
+                scan(st, covered)
+            for h in node.handlers:
+                for st in h.body:
+                    scan(st, caught)  # raising out of except escapes
+            for st in node.orelse + node.finalbody:
+                scan(st, caught)
+            return
+        for child in ast.iter_child_nodes(node):
+            scan(child, caught)
+
+    for st in getattr(fn, "body", ()):
+        scan(st, False)
+    return out
+
+
+@register
+class OnewayRaiseRule(Rule):
+    name = "oneway-exception"
+    code = "GL011"
+    description = ("raise/assert escaping a oneway=True handler is "
+                   "silently swallowed by the RPC dispatch")
+    invariant = ("oneway handlers never signal errors by raising: no "
+                 "caller and no log ever observes them")
+    interests = ("Call", "FunctionDef", "AsyncFunctionDef")
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        self._oneway_handlers: set[str] = set()
+        # name -> first same-module function def of that name
+        self._functions: dict[str, ast.AST] = {}
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._functions.setdefault(node.name, node)
+            return
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "register"
+                and len(node.args) >= 2):
+            return
+        oneway = any(kw.arg == "oneway" and _is_true(kw.value)
+                     for kw in node.keywords)
+        if not oneway and len(node.args) >= 3:
+            oneway = _is_true(node.args[2])
+        if not oneway:
+            return
+        name = _handler_name(node.args[1])
+        if name is not None:
+            self._oneway_handlers.add(name)
+
+    def end_module(self, ctx: ModuleContext) -> None:
+        for name in sorted(self._oneway_handlers):
+            fn = self._functions.get(name)
+            if fn is None:
+                continue
+            for node in _escaping_raises(fn):
+                kind = ("assert" if isinstance(node, ast.Assert)
+                        else "raise")
+                ctx.report(self, node,
+                           f"{name} is registered oneway=True: this "
+                           f"{kind} is silently swallowed by the RPC "
+                           "dispatch (oneway handlers have no reply "
+                           "path and errors are dropped) — handle it "
+                           "locally or register the method two-way")

@@ -10,6 +10,7 @@ Reference parity: python/ray/scripts/scripts.py — `ray start --head`,
   python -m ray_tpu.scripts.cli list {actors|nodes|pgs} --address ...
   python -m ray_tpu.scripts.cli timeline --address HOST:PORT -o out.json
   python -m ray_tpu.scripts.cli metrics  --address HOST:PORT
+  python -m ray_tpu.scripts.cli debug-dump --address HOST:PORT [-o DIR]
   python -m ray_tpu.scripts.cli stop   [--session-dir DIR]
 """
 
@@ -164,6 +165,26 @@ def cmd_metrics(args):
     return 0
 
 
+def cmd_debug_dump(args):
+    """Flight recorder: one post-mortem directory — state listings,
+    memory report, serve/llm status, merged timeline, cluster metrics,
+    per-node log tails. Deadline-bounded and best-effort, so it works
+    against a degraded cluster too."""
+    from ray_tpu.util import state
+
+    out = state.debug_dump(out_dir=args.output, address=args.address,
+                           deadline_s=args.deadline)
+    with open(os.path.join(out, "summary.json")) as f:
+        summary = json.load(f)
+    ok, bad = summary.get("artifacts", {}), summary.get("errors", {})
+    print(f"wrote debug dump to {out} "
+          f"({len(ok)} artifacts, {len(bad)} failures, "
+          f"{summary.get('elapsed_s', 0.0)}s)")
+    for name, err in bad.items():
+        print(f"  FAILED {name}: {err}", file=sys.stderr)
+    return 0
+
+
 def cmd_logs(args):
     """Stream node logs (reference: `ray logs` over the log monitor,
     _private/log_monitor.py:103)."""
@@ -286,6 +307,17 @@ def main(argv=None):
                                        "Prometheus metrics page")
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("debug-dump",
+                       help="write a one-call post-mortem directory "
+                            "(state listings, memory, serve/llm "
+                            "status, timeline, metrics, log tails)")
+    p.add_argument("--address", required=True)
+    p.add_argument("-o", "--output", default=None,
+                   help="output directory (default: timestamped)")
+    p.add_argument("--deadline", type=float, default=60.0,
+                   help="total wall-time budget in seconds")
+    p.set_defaults(fn=cmd_debug_dump)
 
     p = sub.add_parser("logs")
     p.add_argument("node", help="node id (hex prefix)")

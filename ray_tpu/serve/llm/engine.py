@@ -177,6 +177,10 @@ class LLMEngine:
         # holds _step_lock — so within one step() every sampled token
         # sees ONE version (no mid-decode-step version mix)
         self._weight_version = 0  # guarded_by(_step_lock)
+        # cumulative per-phase seconds over finished requests — the
+        # llm_status()/engine_stats() aggregate of the waterfall
+        self._phase_totals: dict[str, float] = {}  # guarded_by(_lock)
+        self._finished_requests = 0  # guarded_by(_lock)
         self._build_metrics()
 
     # ----------------------------------------------------------- metrics
@@ -248,6 +252,22 @@ class LLMEngine:
             "+ prefix-cache invalidation), streams in flight",
             boundaries=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10),
             tag_keys=tags)
+        # SLO attribution plane (direction 2's autoscaler input): TTFT
+        # decomposed into its queue and prefill components, and TPOT
+        # (decode seconds per generated token after the first)
+        self._m_slo_ttft = Histogram(
+            "serve_slo_ttft_ms",
+            "Time to first token, decomposed: phase=queue (admission "
+            "wait), phase=prefill (prefix match + prefill work), "
+            "phase=total",
+            boundaries=(1, 5, 10, 25, 50, 100, 250, 500, 1000, 5000),
+            tag_keys=("model", "phase"))
+        self._m_slo_tpot = Histogram(
+            "serve_slo_tpot_ms",
+            "Time per output token after the first (decode phase "
+            "seconds / tokens)",
+            boundaries=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500),
+            tag_keys=tags)
         # counter deltas are computed against the last pump
         self._last_prefix = (0, 0, 0)
 
@@ -275,6 +295,13 @@ class LLMEngine:
             raise ValueError("empty prompt")
         seq = Sequence(seq_id=next(self._ids), prompt=prompt,
                        sampling=sampling)
+        # the request's trace context: a child of whatever span chain
+        # submitted it (handle call, proxy request), so the finalize-
+        # time waterfall spans correlate by trace_id
+        from ray_tpu.util import tracing
+        from ray_tpu.utils.events import child_trace
+
+        seq.trace = child_trace(tracing.current_trace())
         stream = RequestStream(seq.seq_id)
         with self._lock:
             # validate (scheduler.add raises on over-long prompts) BEFORE
@@ -388,6 +415,7 @@ class LLMEngine:
             self._finalize(seq)
             return
         self._m_chunks.inc(tags=self._m_tags)
+        seq.note_phase("prefill")  # chunk + its scheduling gap
         with self._lock:
             # full pages covered by this chunk are now shareable (the
             # state check skips sequences aborted mid-flight: their
@@ -396,9 +424,21 @@ class LLMEngine:
         if not work.is_last:
             return  # intermediate chunk: no token was produced
         if seq.first_token_at is None:
+            now = time.monotonic()
             self._m_ttft.observe(
-                (time.monotonic() - seq.enqueued_at) * 1e3,
-                tags=self._m_tags)
+                (now - seq.enqueued_at) * 1e3, tags=self._m_tags)
+            # TTFT split for the SLO plane: queue vs prefill work
+            ph = seq.phases
+            self._m_slo_ttft.observe(
+                (ph.get("queue", 0.0) + ph.get("preempt", 0.0)) * 1e3,
+                tags={"model": self.config.model, "phase": "queue"})
+            self._m_slo_ttft.observe(
+                (ph.get("prefix_match", 0.0) + ph.get("prefill", 0.0))
+                * 1e3,
+                tags={"model": self.config.model, "phase": "prefill"})
+            self._m_slo_ttft.observe(
+                (now - seq.enqueued_at) * 1e3,
+                tags={"model": self.config.model, "phase": "total"})
         if sp.logprobs:
             seq.logprobs.append(self._logprob_of(last, nxt, sp.temperature))
         with self._lock:
@@ -430,6 +470,9 @@ class LLMEngine:
             if s.sampling.logprobs:
                 s.logprobs.append(self._logprob_of(
                     logits[i], tok, s.sampling.temperature))
+        now = time.monotonic()
+        for s in work.seqs:
+            s.note_phase("decode", now)  # step + its scheduling gap
         finished = []
         with self._lock:
             for s, tok in zip(work.seqs, next_tokens):
@@ -474,6 +517,23 @@ class LLMEngine:
         outcome = (seq.finish_reason or "unknown").split(":", 1)[0]
         self._m_requests.inc(
             tags={"model": self.config.model, "outcome": outcome})
+        # ---- latency attribution: close the waterfall -----------------
+        now = time.monotonic()
+        # the tail interval (last step end -> this close): queue time if
+        # the request never ran (aborted while waiting), else emit
+        seq.note_phase("emit" if seq.phases else "queue", now)
+        e2e = now - seq.enqueued_at
+        breakdown = {k: round(v, 6) for k, v in seq.phases.items()}
+        breakdown["e2e"] = round(e2e, 6)
+        if len(seq.generated) > 1 and seq.phases.get("decode"):
+            self._m_slo_tpot.observe(
+                seq.phases["decode"] * 1e3 / (len(seq.generated) - 1),
+                tags=self._m_tags)
+        with self._lock:
+            self._finished_requests += 1
+            for k, v in seq.phases.items():
+                self._phase_totals[k] = self._phase_totals.get(k, 0.0) + v
+        self._record_request_spans(seq, now)
         versions = sorted(set(seq.token_versions))
         final = {
             "done": True,
@@ -494,11 +554,39 @@ class LLMEngine:
             "weight_versions": versions,
             "stale": seq.kv_stale or len(versions) > 1,
         }
+        final["breakdown"] = breakdown
         if seq.sampling.echo:
             final["prompt_token_ids"] = list(seq.prompt)
         if seq.sampling.logprobs:
             final["logprobs"] = list(seq.logprobs)
         stream._close(final)
+
+    # deterministic waterfall order for the laid-out request spans
+    _PHASE_ORDER = ("queue", "prefix_match", "prefill", "preempt",
+                    "decode", "emit")
+
+    def _record_request_spans(self, seq: Sequence, now: float) -> None:
+        """Emit the request's waterfall as child spans: one parent
+        `llm.request` over [enqueue, close] plus one child per nonzero
+        phase, laid out contiguously in waterfall order (phases
+        interleave in real time — chunked prefill alternates with
+        decode — so the contiguous layout is the readable summary, and
+        the durations are the exact per-phase totals). All hang off the
+        request's propagated trace context."""
+        from ray_tpu.util import tracing
+        from ray_tpu.utils.events import child_trace
+
+        tracing.record_interval("llm.request", seq.enqueued_at, now,
+                                category="serve", trace=seq.trace)
+        cursor = seq.enqueued_at
+        for phase in self._PHASE_ORDER:
+            dur = seq.phases.get(phase, 0.0)
+            if dur <= 0.0:
+                continue
+            tracing.record_interval(
+                f"llm.request.{phase}", cursor, cursor + dur,
+                category="serve", trace=child_trace(seq.trace))
+            cursor += dur
 
     # ------------------------------------------------------------- admin
 
@@ -566,6 +654,9 @@ class LLMEngine:
 
     def stats(self) -> dict:
         d = self.scheduler.depth()
+        with self._lock:
+            phase_totals = dict(self._phase_totals)
+            finished = self._finished_requests
         d.update({
             "model": self.config.model,
             "block_size": self.pool.block_size,
@@ -573,6 +664,10 @@ class LLMEngine:
             "max_model_len": self.runner.max_model_len,
             "compiled_programs": self.runner.compiled_signatures(),
             "weight_version": self._weight_version,
+            # cumulative waterfall over finished requests — surfaced
+            # per replica by util.state.llm_status()
+            "phase_seconds": phase_totals,
+            "finished_requests": finished,
         })
         return d
 

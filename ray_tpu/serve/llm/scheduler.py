@@ -103,6 +103,31 @@ class Sequence:
     enqueued_at: float = dataclasses.field(default_factory=time.monotonic)
     first_token_at: float | None = None
     finish_reason: str | None = None
+    # ---- latency attribution (the per-request waterfall) ----
+    # Interval accounting: `_mark` is where attribution left off; every
+    # phase transition charges [_mark, now) to ONE phase and advances
+    # the mark, so the phases always sum to exactly the wall time from
+    # enqueue to the last transition — the property the breakdown's
+    # "sums to e2e" contract rests on. Phases: queue (waiting for
+    # admission), prefix_match (the successful admission's cache
+    # lookup), prefill (chunk execution, incl. recompute after
+    # preemption), decode (decode steps + their scheduling gaps),
+    # preempt (evicted, waiting for re-admission), emit (finalize tail).
+    phases: dict[str, float] = dataclasses.field(default_factory=dict)
+    _mark: float = dataclasses.field(default_factory=time.monotonic)
+    _preempt_wait: bool = False  # between preemption and re-admission
+    # request trace context (set by the engine at add_request): the
+    # finalize-time waterfall spans hang off this, so one request's
+    # phase spans correlate with its handle/proxy spans by trace_id
+    trace: dict | None = None
+
+    def note_phase(self, phase: str, now: float | None = None) -> None:
+        """Charge the interval since the last mark to `phase`."""
+        if now is None:
+            now = time.monotonic()
+        self.phases[phase] = self.phases.get(phase, 0.0) \
+            + max(0.0, now - self._mark)
+        self._mark = now
     # lazily extended hash chain over prompt+generated full pages
     _hashes: list[int] = dataclasses.field(default_factory=list)
 
@@ -235,12 +260,20 @@ class Scheduler:
         bs = self.pool.block_size
         # longest-prefix match over FULL pages, capped so at least one
         # token is left to prefill (its logits sample the first token)
+        t_match = time.monotonic()
         matched = self.pool.match_prefix(
             seq.page_hashes((total - 1) // bs, bs))
         if not self.pool.can_alloc(n_pages - len(matched)):
             if matched:
                 self.pool.free(matched)  # drop the refs; stay queued
             return None
+        # waterfall: everything up to the successful match attempt was
+        # queue time (or preempt-wait time after an eviction); the
+        # lookup itself is the prefix_match phase
+        seq.note_phase("preempt" if seq._preempt_wait else "queue",
+                       t_match)
+        seq._preempt_wait = False
+        seq.note_phase("prefix_match")
         self.waiting.popleft()
         self.prefix_hit_pages += len(matched)
         self.prefix_miss_pages += n_pages - len(matched)
@@ -302,6 +335,11 @@ class Scheduler:
         victim re-admits as soon as space frees up. Registered pages the
         victim doesn't share park in the pool's LRU — re-admission
         usually prefix-matches them straight back."""
+        # waterfall: close the running interval (decode-stage time, or
+        # prefill if the victim was still mid-prefill); everything
+        # until re-admission charges to "preempt"
+        seq.note_phase("prefill" if seq.prefill_pending else "decode")
+        seq._preempt_wait = True
         self.running.remove(seq)
         self.pool.free(seq.table)
         seq.table = []

@@ -11,6 +11,8 @@ all-gather traffic that DDP/ZeRO would do by hand.
 from __future__ import annotations
 
 import dataclasses
+import os
+import threading
 import time
 from typing import Any, Callable
 
@@ -23,6 +25,126 @@ from ray_tpu.parallel.mesh import BATCH_AXES
 from ray_tpu.parallel.sharding import PartitionRules
 
 PyTree = Any
+
+
+class StepWaterfall:
+    """Per-step latency attribution for the train path (the direction-5
+    scoreboard companion: MFU says how fast, this says where the time
+    went). OFF by default — the instrumented step checks one bool, so
+    attribution costs nothing when disabled; when enabled it adds a
+    device sync per step (that is the point: a profiling run, not a
+    record run — `bench.py --trace` turns it on).
+
+    Phases per step: ``data_wait`` (caller-reported input fetch, see
+    `note_data_wait`), ``h2d`` (host->device transfer of numpy batch
+    leaves), ``compile`` (steps that tripped an XLA compile),
+    ``compute`` (dispatch + device execution), ``collective``
+    (host-side collective wall time observed during the step — the
+    in-program collective share is only visible to the device
+    profiler). Phases sum to the step's wall time (data_wait + h2d +
+    compile-or-compute; collective is carved out of compute)."""
+
+    def __init__(self):
+        # "0"/"false"/"" all mean OFF — an operator writing =0 to be
+        # explicit must not silently enable per-step device syncs
+        self.enabled = os.environ.get(
+            "RAY_TPU_STEP_WATERFALL", "").strip().lower() \
+            not in ("", "0", "false", "no")
+        self._lock = threading.Lock()
+        self.phases: dict[str, float] = {}  # guarded_by(_lock)
+        self.steps = 0  # guarded_by(_lock)
+        self._pending_data_wait = 0.0  # guarded_by(_lock)
+        self._last_step_end: float | None = None  # guarded_by(_lock)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.phases = {}
+            self.steps = 0
+            self._pending_data_wait = 0.0
+            self._last_step_end = None
+
+    def step_gap(self, t_start: float, data_wait: float) -> float:
+        """Host time between the previous step's end and this step's
+        start not already claimed by data_wait — the python/dispatch
+        overhead of the train loop itself (charged to `host`, so a
+        loop's phase totals sum wall-to-wall to its elapsed time)."""
+        with self._lock:
+            last = self._last_step_end
+        if last is None:
+            return 0.0
+        return max(0.0, t_start - last - data_wait)
+
+    def mark_step_end(self, t_end: float) -> None:
+        with self._lock:
+            self._last_step_end = t_end
+
+    def note_data_wait(self, seconds: float) -> None:
+        """Report time spent fetching/waiting for the NEXT batch (data
+        pipeline stall); charged to the next instrumented step."""
+        with self._lock:
+            self._pending_data_wait += max(0.0, seconds)
+
+    def take_data_wait(self) -> float:
+        with self._lock:
+            dw, self._pending_data_wait = self._pending_data_wait, 0.0
+            return dw
+
+    def add(self, step_phases: dict[str, float]) -> None:
+        with self._lock:
+            for k, v in step_phases.items():
+                if v > 0.0:
+                    self.phases[k] = self.phases.get(k, 0.0) + v
+            self.steps += 1
+
+    def summary(self) -> dict:
+        with self._lock:
+            phases = dict(self.phases)
+            steps = self.steps
+        total = sum(phases.values())
+        return {"steps": steps, "total_seconds": total,
+                "phases": phases,
+                "percent": {k: (100.0 * v / total if total else 0.0)
+                            for k, v in phases.items()}}
+
+    def table(self) -> str:
+        """Human attribution table: percent of step time per phase."""
+        s = self.summary()
+        lines = [f"# step attribution over {s['steps']} steps "
+                 f"({s['total_seconds']:.3f}s attributed)"]
+        for k, v in sorted(s["phases"].items(), key=lambda kv: -kv[1]):
+            lines.append(f"#   {k:<12} {v:9.4f}s  {s['percent'][k]:5.1f}%")
+        return "\n".join(lines)
+
+
+waterfall = StepWaterfall()
+
+
+def enable_step_waterfall(on: bool = True) -> None:
+    """Turn per-step attribution on/off in THIS process. Worker
+    processes inherit it from the RAY_TPU_STEP_WATERFALL env var
+    (settable via runtime_env/setup_env), so a WorkerGroup gang can be
+    flipped into profiling mode without code changes."""
+    waterfall.enabled = on
+
+
+class data_wait:
+    """Context manager charging the enclosed block to the next step's
+    ``data_wait`` phase — wrap your batch fetch::
+
+        with spmd.data_wait():
+            batch = next(batch_iter)
+        state, metrics = step(state, batch)
+
+    No-op (beyond two clock reads) when attribution is disabled."""
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if waterfall.enabled:
+            waterfall.note_data_wait(time.perf_counter() - self._t0)
+        return False
 
 
 @jax.tree_util.register_dataclass
@@ -105,8 +227,68 @@ def make_train_step(
     m_compile = Histogram(
         "train_compile_seconds", "XLA compile time for the train step",
         boundaries=(0.1, 0.5, 1, 5, 10, 30, 60, 120, 300))
+    m_phase = Histogram(
+        "train_step_phase_seconds",
+        "Per-step waterfall phases (data_wait/h2d/compile/collective/"
+        "compute) — populated only while step attribution is enabled",
+        boundaries=(0.0001, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5,
+                    30),
+        tag_keys=("phase",))
+
+    def _attributed_step(state: TrainState, batch: PyTree):
+        """Waterfall-mode step: wall-to-wall phase attribution. Adds a
+        device sync per step (a profiling run, not a record run)."""
+        from ray_tpu.util import tracing
+        from ray_tpu.util.collective import _collective_seconds
+
+        data_wait = waterfall.take_data_wait()
+        t0 = time.perf_counter()
+        gap = waterfall.step_gap(t0, data_wait)
+        leaves = jax.tree_util.tree_leaves(batch)
+        if any(not isinstance(x, jax.Array) for x in leaves):
+            # numpy/host leaves: the h2d copy jit would do implicitly,
+            # made explicit so it is timed as its own phase
+            batch = jax.block_until_ready(jax.device_put(batch))
+        t1 = time.perf_counter()
+        coll0 = _collective_seconds().sum_total()
+        before = tracing.jit_cache_size(jitted)
+        out = jitted(state, batch)
+        # sync on the metrics dict (small leaves), not the new state:
+        # blocking on loss/grad_norm means the whole step has executed
+        out = (out[0], jax.block_until_ready(out[1]))
+        t3 = time.perf_counter()
+        dt = t3 - t1
+        compiled = tracing.note_compile_if_grew(
+            jitted, before, dt, m_miss, m_compile, "train.compile")
+        coll = min(max(0.0, _collective_seconds().sum_total() - coll0),
+                   dt)
+        phases = {"data_wait": data_wait, "h2d": t1 - t0,
+                  "collective": coll, "host": gap}
+        phases["compile" if compiled else "compute"] = dt - coll
+        if not compiled:
+            m_step.observe(dt)
+        for k, v in phases.items():
+            if v > 0.0:
+                m_phase.observe(v, tags={"phase": k})
+        # laid-out sub-spans: data_wait | h2d | compile-or-compute, at
+        # their true monotonic positions (perf_counter IS the monotonic
+        # clock on linux; record_interval re-anchors to the epoch)
+        if data_wait > 0.0:
+            tracing.record_interval("train.step.data_wait",
+                                    t0 - data_wait, t0, category="train")
+        if t1 - t0 > 0.0:
+            tracing.record_interval("train.step.h2d", t0, t1,
+                                    category="train")
+        tracing.record_interval(
+            "train.step.compile" if compiled else "train.step.compute",
+            t1, t3, category="train")
+        waterfall.add(phases)
+        waterfall.mark_step_end(t3)
+        return out
 
     def instrumented(state: TrainState, batch: PyTree):
+        if waterfall.enabled:
+            return _attributed_step(state, batch)
         from ray_tpu.util import tracing
 
         before = tracing.jit_cache_size(jitted)

@@ -120,6 +120,22 @@ class TrainWorker:
                          name=f"train-fn-rank{self.rank}").start()
         return True
 
+    def set_step_waterfall(self, on: bool = True) -> bool:
+        """Flip per-step latency attribution in this worker process
+        (train/spmd.py waterfall) — works after spmd is imported, unlike
+        the RAY_TPU_STEP_WATERFALL env var which is read at import."""
+        os.environ["RAY_TPU_STEP_WATERFALL"] = "1" if on else ""
+        from ray_tpu.train import spmd
+
+        spmd.enable_step_waterfall(on)
+        return True
+
+    def step_waterfall_summary(self) -> dict:
+        """This rank's accumulated per-step phase attribution."""
+        from ray_tpu.train import spmd
+
+        return spmd.waterfall.summary()
+
     def next_result(self, timeout: float = 5.0) -> dict:
         """One report from this worker's session, or a status sentinel.
         Driven by the driver's result loop (reference:
@@ -211,6 +227,13 @@ class WorkerGroup:
             ref = getattr(self.workers[rank], method).remote(*args,
                                                              **kwargs)
             return ray_tpu.get(ref, timeout=timeout)
+
+    def enable_step_waterfall(self, on: bool = True) -> list:
+        """Flip per-step attribution on EVERY rank; fetch the per-rank
+        phase tables afterwards with
+        ``execute("step_waterfall_summary")`` (straggler ranks show up
+        as one rank's compute/collective share diverging)."""
+        return self.execute("set_step_waterfall", on)
 
     def execute_async(self, method: str, *args, **kwargs) -> list:
         from ray_tpu.util import tracing

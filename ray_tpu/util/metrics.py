@@ -152,6 +152,13 @@ class Histogram(Metric):
             self._sums[k] = self._sums.get(k, 0.0) + value
             self._totals[k] = self._totals.get(k, 0) + 1
 
+    def sum_total(self) -> float:
+        """Sum of all observed values across every tag combination —
+        the cheap 'how much time went here so far' probe waterfall
+        snapshots diff."""
+        with self._lock:
+            return sum(self._sums.values())
+
     def expose(self) -> list[str]:
         lines = [f"# HELP {self.name} {self.description}",
                  f"# TYPE {self.name} histogram"]

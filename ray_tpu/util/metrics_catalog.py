@@ -1,0 +1,193 @@
+"""The metric catalog — the single machine-readable registry of every
+metric this codebase can emit.
+
+Three consumers keep each other honest through it (the CI drift gate in
+tests/test_observability4.py):
+
+- the SOURCE: every ``Counter/Gauge/Histogram("name", ...)`` literal in
+  the package (extracted by `source_metrics()`, an AST scan) must have
+  a catalog entry, and vice versa;
+- the DOCS: every catalog name must appear in OBSERVABILITY.md's
+  catalog table, and every metric named there must exist here;
+- the DASHBOARD: ``python -m ray_tpu.devtools.grafana`` generates
+  dashboards/ray_tpu.json from this catalog (one panel per metric,
+  typed expressions), and the committed JSON must match a regeneration.
+
+Adding a metric therefore means: construct it, add its row here, add
+its OBSERVABILITY.md row, regenerate the dashboard. Forgetting any of
+the four fails the gate.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+# (name, type, where, what) — grouped/ordered like OBSERVABILITY.md
+CATALOG: list[dict] = [
+    # train
+    {"name": "train_step_seconds", "type": "histogram",
+     "where": "ray_tpu/train/spmd.py",
+     "what": "host-side train-step dispatch time"},
+    {"name": "train_compile_misses_total", "type": "counter",
+     "where": "ray_tpu/train/spmd.py",
+     "what": "train steps that triggered an XLA compile"},
+    {"name": "train_compile_seconds", "type": "histogram",
+     "where": "ray_tpu/train/spmd.py",
+     "what": "XLA compile time for the train step"},
+    {"name": "train_step_phase_seconds", "type": "histogram",
+     "where": "ray_tpu/train/spmd.py",
+     "what": "per-step waterfall phases (attribution runs only)"},
+    # collectives
+    {"name": "collective_seconds", "type": "histogram",
+     "where": "ray_tpu/util/collective.py",
+     "what": "host-side collective wall time (offer -> ready)"},
+    # object plane
+    {"name": "object_store_pull_bytes_total", "type": "counter",
+     "where": "ray_tpu/core/nodelet.py",
+     "what": "inbound node-to-node object transfer bytes"},
+    {"name": "object_store_pull_seconds", "type": "histogram",
+     "where": "ray_tpu/core/nodelet.py",
+     "what": "inbound node-to-node object transfer latency"},
+    {"name": "object_store_push_bytes_total", "type": "counter",
+     "where": "ray_tpu/core/nodelet.py",
+     "what": "bytes served to other nodes"},
+    {"name": "object_store_bytes_allocated", "type": "gauge",
+     "where": "ray_tpu/core/nodelet.py",
+     "what": "store occupancy in bytes (refreshed at scrape)"},
+    {"name": "object_store_num_objects", "type": "gauge",
+     "where": "ray_tpu/core/nodelet.py", "what": "objects resident"},
+    {"name": "object_store_evictions", "type": "gauge",
+     "where": "ray_tpu/core/nodelet.py", "what": "objects evicted"},
+    {"name": "object_store_created_objects_total", "type": "counter",
+     "where": "ray_tpu/core/object_store.py",
+     "what": "per-process store writes (count)"},
+    {"name": "object_store_created_bytes_total", "type": "counter",
+     "where": "ray_tpu/core/object_store.py",
+     "what": "per-process store writes (bytes)"},
+    # serve.llm engine
+    {"name": "serve_llm_tokens_generated_total", "type": "counter",
+     "where": "ray_tpu/serve/llm/engine.py", "what": "tokens generated"},
+    {"name": "serve_llm_requests_total", "type": "counter",
+     "where": "ray_tpu/serve/llm/engine.py",
+     "what": "requests finished, by outcome"},
+    {"name": "serve_llm_preemptions_total", "type": "counter",
+     "where": "ray_tpu/serve/llm/engine.py",
+     "what": "sequences preempted on cache exhaustion"},
+    {"name": "serve_llm_queue_depth", "type": "gauge",
+     "where": "ray_tpu/serve/llm/engine.py", "what": "waiting requests"},
+    {"name": "serve_llm_running", "type": "gauge",
+     "where": "ray_tpu/serve/llm/engine.py",
+     "what": "sequences in the decode set"},
+    {"name": "serve_llm_cache_utilization", "type": "gauge",
+     "where": "ray_tpu/serve/llm/engine.py",
+     "what": "KV pool pages in use / usable"},
+    {"name": "serve_llm_tokens_per_sec", "type": "gauge",
+     "where": "ray_tpu/serve/llm/engine.py",
+     "what": "generation throughput (~5s window)"},
+    {"name": "serve_llm_ttft_ms", "type": "histogram",
+     "where": "ray_tpu/serve/llm/engine.py", "what": "time to first token"},
+    {"name": "serve_llm_step_ms", "type": "histogram",
+     "where": "ray_tpu/serve/llm/engine.py",
+     "what": "engine step latency, by kind"},
+    {"name": "serve_llm_prefix_cache_hits_total", "type": "counter",
+     "where": "ray_tpu/serve/llm/engine.py",
+     "what": "KV pages served from the prefix cache at admission"},
+    {"name": "serve_llm_prefix_cache_misses_total", "type": "counter",
+     "where": "ray_tpu/serve/llm/engine.py",
+     "what": "KV pages prefilled at admission"},
+    {"name": "serve_llm_prefix_cache_evictions_total", "type": "counter",
+     "where": "ray_tpu/serve/llm/engine.py",
+     "what": "cached refcount-0 pages evicted for reuse"},
+    {"name": "serve_llm_prefix_cached_blocks", "type": "gauge",
+     "where": "ray_tpu/serve/llm/engine.py",
+     "what": "refcount-0 pages retained for prefix reuse"},
+    {"name": "serve_llm_prefill_chunks_total", "type": "counter",
+     "where": "ray_tpu/serve/llm/engine.py", "what": "prefill chunks run"},
+    {"name": "serve_llm_prefill_stall_ms", "type": "histogram",
+     "where": "ray_tpu/serve/llm/engine.py",
+     "what": "decode stall imposed by a prefill step"},
+    {"name": "serve_llm_compile_misses_total", "type": "counter",
+     "where": "ray_tpu/serve/llm/runner.py",
+     "what": "prefill/decode calls that triggered an XLA compile"},
+    {"name": "serve_llm_compile_seconds", "type": "histogram",
+     "where": "ray_tpu/serve/llm/runner.py",
+     "what": "XLA compile time per LLM program"},
+    {"name": "serve_llm_weight_swaps_total", "type": "counter",
+     "where": "ray_tpu/serve/llm/engine.py",
+     "what": "weight hot-swaps installed at a step boundary"},
+    # serve SLO attribution (the per-request waterfall's metric face)
+    {"name": "serve_slo_ttft_ms", "type": "histogram",
+     "where": "ray_tpu/serve/llm/engine.py",
+     "what": "TTFT decomposed: phase=queue|prefill|total"},
+    {"name": "serve_slo_tpot_ms", "type": "histogram",
+     "where": "ray_tpu/serve/llm/engine.py",
+     "what": "decode seconds per output token after the first"},
+    # serve proxy
+    {"name": "serve_num_http_requests", "type": "counter",
+     "where": "ray_tpu/serve/api.py", "what": "HTTP ingress, by status"},
+    {"name": "serve_http_request_latency_ms", "type": "histogram",
+     "where": "ray_tpu/serve/api.py", "what": "HTTP ingress latency"},
+    # RL flywheel
+    {"name": "rl_rollout_tokens_total", "type": "counter",
+     "where": "ray_tpu/rllib/llm/rollout.py",
+     "what": "tokens generated by RL rollouts"},
+    {"name": "rl_reward_mean", "type": "gauge",
+     "where": "ray_tpu/rllib/llm/rollout.py",
+     "what": "mean reward of the latest rollout batch"},
+    {"name": "rl_traj_staleness", "type": "histogram",
+     "where": "ray_tpu/rllib/llm/learner.py",
+     "what": "weight-version lag of offered trajectories"},
+    {"name": "rl_traj_dropped_total", "type": "counter",
+     "where": "ray_tpu/rllib/llm/learner.py",
+     "what": "trajectories refused by the staleness guard"},
+    {"name": "rl_weight_swap_seconds", "type": "histogram",
+     "where": "ray_tpu/serve/llm/engine.py",
+     "what": "drain-free weight hot-swap wall time"},
+    # span plane
+    {"name": "spans_sampled_total", "type": "counter",
+     "where": "ray_tpu/utils/events.py",
+     "what": "spans admitted into the local buffer, by category"},
+    {"name": "spans_dropped_total", "type": "counter",
+     "where": "ray_tpu/utils/events.py",
+     "what": "spans rejected (sampling policy or full buffer)"},
+]
+
+
+def catalog_names() -> set[str]:
+    return {m["name"] for m in CATALOG}
+
+
+def source_metrics(package_root: str | None = None) -> dict[str, str]:
+    """{metric name: type} for every Counter/Gauge/Histogram construction
+    with a literal name in the package source — the 'registered at
+    runtime' side of the drift gate, extracted statically so the gate
+    covers paths no test instantiates."""
+    if package_root is None:
+        package_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+    found: dict[str, str] = {}
+    for dirpath, dirnames, filenames in os.walk(package_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read())
+            except (OSError, SyntaxError):
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                f_ = node.func
+                ctor = (f_.id if isinstance(f_, ast.Name)
+                        else f_.attr if isinstance(f_, ast.Attribute)
+                        else None)
+                if ctor in ("Counter", "Gauge", "Histogram") \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    found[node.args[0].value] = ctor.lower()
+    return found

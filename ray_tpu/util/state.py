@@ -9,7 +9,7 @@ from __future__ import annotations
 
 
 def _head_call(method: str, msg: dict | None = None,
-               address: str | None = None):
+               address: str | None = None, timeout: float = 30):
     from ray_tpu.core.rpc import RpcClient
 
     if address is None:
@@ -20,15 +20,19 @@ def _head_call(method: str, msg: dict | None = None,
             raise RuntimeError("state API needs ray_tpu.init() or an "
                                "explicit head address")
         address = rt.head_address
-    return RpcClient.shared().call(address, method, msg or {}, timeout=30)
+    return RpcClient.shared().call(address, method, msg or {},
+                                   timeout=timeout)
 
 
-def list_actors(address: str | None = None) -> list[dict]:
-    return _head_call("list_actors", address=address)["actors"]
+def list_actors(address: str | None = None,
+                timeout: float = 30) -> list[dict]:
+    return _head_call("list_actors", address=address,
+                      timeout=timeout)["actors"]
 
 
-def list_nodes(address: str | None = None) -> list[dict]:
-    view = _head_call("cluster_view", address=address)
+def list_nodes(address: str | None = None,
+               timeout: float = 30) -> list[dict]:
+    view = _head_call("cluster_view", address=address, timeout=timeout)
     return [
         {
             "node_id": n["node_id"].hex(),
@@ -42,29 +46,34 @@ def list_nodes(address: str | None = None) -> list[dict]:
     ]
 
 
-def list_tasks(address: str | None = None, limit: int = 1000) -> list[dict]:
+def list_tasks(address: str | None = None, limit: int = 1000,
+               timeout: float = 30) -> list[dict]:
     """Executor-reported task events (reference: `ray list tasks` over
     GcsTaskManager task events)."""
     return _head_call("list_tasks", {"limit": limit},
-                      address=address)["tasks"]
+                      address=address, timeout=timeout)["tasks"]
 
 
-def cluster_metrics(address: str | None = None) -> str:
+def cluster_metrics(address: str | None = None,
+                    timeout: float = 30) -> str:
     """One Prometheus page for the whole cluster: the head scrapes every
     alive nodelet (which fans out to its workers) and injects node/proc
     tags (reference: the dashboard's cluster metrics aggregation)."""
-    return _head_call("cluster_metrics", address=address)["text"]
+    return _head_call("cluster_metrics", address=address,
+                      timeout=timeout)["text"]
 
 
 def cluster_timeline(address: str | None = None,
-                     filename: str | None = None):
+                     filename: str | None = None, timeout: float = 30):
     """The merged cluster chrome trace from the head's span buffer
-    (pid = node, tid = worker/thread, epoch-aligned timestamps). In a
-    connected driver prefer `ray_tpu.timeline()`, which also flushes the
-    driver's own spans first."""
+    (pid = node, tid = worker/thread, epoch-aligned timestamps; spilled
+    history merged back in). In a connected driver prefer
+    `ray_tpu.timeline()`, which also flushes the driver's own spans
+    first."""
     from ray_tpu.utils.events import merge_spans
 
-    spans = _head_call("dump_timeline", address=address)["spans"]
+    spans = _head_call("dump_timeline", address=address,
+                       timeout=timeout)["spans"]
     return merge_spans(spans, filename)
 
 
@@ -110,14 +119,18 @@ def tail_log(node_id: str, file: str, nbytes: int = 64 * 1024,
     return frames[0].decode(errors="replace"), value["end_offset"]
 
 
-def list_placement_groups(address: str | None = None) -> list[dict]:
-    return _head_call("pg_table", address=address).get("groups", [])
+def list_placement_groups(address: str | None = None,
+                          timeout: float = 30) -> list[dict]:
+    return _head_call("pg_table", address=address,
+                      timeout=timeout).get("groups", [])
 
 
-def _node_object_tables(address: str | None) -> tuple[list[dict],
-                                                      list[dict]]:
+def _node_object_tables(address: str | None, timeout: float = 20
+                        ) -> tuple[list[dict], list[dict]]:
     """One fan-out pass: (per-node rows incl. store stats, all owned
-    objects — workers' via their nodelet + the calling driver's own)."""
+    objects — workers' via their nodelet + the calling driver's own).
+    `timeout` bounds each per-node call (a dead-but-not-yet-aged node
+    costs at most that)."""
     from ray_tpu.core import api as _api
     from ray_tpu.core.rpc import RpcClient
 
@@ -126,12 +139,12 @@ def _node_object_tables(address: str | None) -> tuple[list[dict],
     if rt is not None and hasattr(rt, "_h_list_objects"):
         objects.extend(rt._h_list_objects({}, [])["objects"])
     nodes = []
-    for n in list_nodes(address):
+    for n in list_nodes(address, timeout=timeout):
         if not n["alive"]:
             continue
         try:
             r = RpcClient.shared().call(n["address"], "list_node_objects",
-                                        {}, timeout=20)
+                                        {}, timeout=timeout)
         except Exception:  # noqa: BLE001
             continue
         objects.extend(r.get("objects", ()))
@@ -148,18 +161,20 @@ def _node_object_tables(address: str | None) -> tuple[list[dict],
     return nodes, objects
 
 
-def list_objects(address: str | None = None) -> list[dict]:
+def list_objects(address: str | None = None,
+                 timeout: float = 20) -> list[dict]:
     """Cluster-wide owner-side object tables (reference:
     `ray list objects`, python/ray/util/state/api.py:1). Covers every
     worker's owned objects via its nodelet, plus the calling driver's
     own table."""
-    return _node_object_tables(address)[1]
+    return _node_object_tables(address, timeout)[1]
 
 
-def memory_summary(address: str | None = None) -> dict:
+def memory_summary(address: str | None = None,
+                   timeout: float = 20) -> dict:
     """Per-node store usage + per-owner object footprint (reference:
     the `ray memory` report)."""
-    nodes, objects = _node_object_tables(address)
+    nodes, objects = _node_object_tables(address, timeout)
     by_owner: dict[str, dict] = {}
     for o in objects:
         agg = by_owner.setdefault(o["owner"], {"count": 0, "bytes": 0,
@@ -176,9 +191,10 @@ def memory_summary(address: str | None = None) -> dict:
     }
 
 
-def memory_report(address: str | None = None) -> str:
+def memory_report(address: str | None = None,
+                  timeout: float = 20) -> str:
     """Human-readable `ray_tpu memory` view."""
-    s = memory_summary(address)
+    s = memory_summary(address, timeout)
     lines = ["=== object store per node ==="]
     for n in s["nodes"]:
         cap = n["store_capacity"] or 1
@@ -245,21 +261,191 @@ def serve_status(address: str | None = None) -> dict:
     return serve.status()
 
 
-def llm_status(app_name: str) -> list[dict]:
+def llm_status(app_name: str, timeout: float = 30) -> list[dict]:
     """Per-replica LLM engine stats for a `serve.llm` app: queue depth,
     running lanes, cache utilization, preemptions, compiled-program
-    count. One dict per replica (the handle routes to a single replica;
-    this asks the controller for the full set). Probes ride the
-    replicas' control concurrency group, so they answer even while
-    every request lane is mid-stream."""
+    count, cumulative request-phase seconds. One dict per replica (the
+    handle routes to a single replica; this asks the controller for the
+    full set). Probes ride the replicas' control concurrency group, so
+    they answer even while every request lane is mid-stream. `timeout`
+    bounds EACH of the two round trips (controller, then replicas)."""
     import ray_tpu
     from ray_tpu.serve.api import _CONTROLLER_NAME
 
     ctrl = ray_tpu.get_actor(_CONTROLLER_NAME)
-    r = ray_tpu.get(ctrl.get_replicas.remote(app_name), timeout=30)
+    r = ray_tpu.get(ctrl.get_replicas.remote(app_name), timeout=timeout)
     if not r["replicas"]:
         raise ValueError(f"no serve application named {app_name!r}")
     refs = [rep.handle_request.options(
         concurrency_group="control").remote("engine_stats", (), {})
         for rep in r["replicas"]]
-    return ray_tpu.get(refs, timeout=30)
+    return ray_tpu.get(refs, timeout=timeout)
+
+
+# --------------------------------------------------------------------------
+# Flight recorder — `debug_dump()` / `ray_tpu debug-dump`
+# --------------------------------------------------------------------------
+
+def debug_dump(out_dir: str | None = None, address: str | None = None,
+               deadline_s: float = 60.0, log_tail_bytes: int = 64 * 1024
+               ) -> str:
+    """One-call cluster flight recorder: write a post-mortem directory
+    with everything an incident writeup needs — state-API listings
+    (nodes/actors/tasks/objects/placement groups), the memory report,
+    serve + llm status, the merged cluster timeline, the cluster-wide
+    /metrics page, and per-node log tails.
+
+    Every artifact is gathered best-effort under ONE deadline: each RPC
+    gets at most min(10s, remaining budget), a dead or hung node costs
+    its timeout and nothing more, and the dump itself never raises —
+    per-artifact failures land in ``summary.json`` next to the
+    successes. The one exception is ``serve.status()``, whose internal
+    probes carry fixed 10-30s timeouts; it is only attempted while >15s
+    of budget remains. Returns the output directory path.
+
+    Layout::
+
+        <dir>/summary.json              what was captured, what failed
+        <dir>/nodes.json ...            state listings
+        <dir>/memory.txt                `ray_tpu memory` report
+        <dir>/serve_status.json         serve apps (when serve is up)
+        <dir>/llm_status.json           per-replica engine stats
+        <dir>/timeline.json             merged chrome trace
+        <dir>/metrics.prom              cluster Prometheus page
+        <dir>/logs/<node12>/<file>      per-node log tails
+    """
+    import json
+    import os
+    import time
+
+    t_wall = time.time()
+    t0 = time.monotonic()
+    deadline = t0 + deadline_s
+    if out_dir is None:
+        out_dir = time.strftime("ray_tpu-debug-%Y%m%d-%H%M%S")
+    os.makedirs(out_dir, exist_ok=True)
+    summary: dict = {"started_at": t_wall, "deadline_s": deadline_s,
+                     "address": address, "artifacts": {}, "errors": {}}
+
+    def budget(cap: float = 10.0) -> float:
+        return max(0.5, min(cap, deadline - time.monotonic()))
+
+    def step(name: str, fn, writer=None):
+        """Run one artifact collector under the shared deadline; record
+        its outcome, never raise."""
+        if time.monotonic() >= deadline:
+            summary["errors"][name] = "deadline exhausted"
+            return None
+        t_a = time.monotonic()
+        try:
+            value = fn()
+        except Exception as e:  # noqa: BLE001
+            summary["errors"][name] = repr(e)
+            return None
+        try:
+            if writer is not None:
+                writer(value)
+        except Exception as e:  # noqa: BLE001
+            summary["errors"][name] = f"write failed: {e!r}"
+            return value
+        summary["artifacts"][name] = round(time.monotonic() - t_a, 3)
+        return value
+
+    def jwrite(fname):
+        def w(value):
+            with open(os.path.join(out_dir, fname), "w") as f:
+                json.dump(value, f, indent=1, default=str)
+        return w
+
+    def twrite(fname):
+        def w(text):
+            with open(os.path.join(out_dir, fname), "w") as f:
+                f.write(text)
+        return w
+
+    nodes = step("nodes",
+                 lambda: list_nodes(address, timeout=budget()),
+                 jwrite("nodes.json"))
+    step("actors", lambda: list_actors(address, timeout=budget()),
+         jwrite("actors.json"))
+    step("tasks", lambda: list_tasks(address, timeout=budget()),
+         jwrite("tasks.json"))
+    step("placement_groups",
+         lambda: list_placement_groups(address, timeout=budget()),
+         jwrite("placement_groups.json"))
+    step("objects", lambda: list_objects(address, timeout=budget()),
+         jwrite("objects.json"))
+    step("memory", lambda: memory_report(address, timeout=budget()),
+         twrite("memory.txt"))
+    step("metrics", lambda: cluster_metrics(address, timeout=budget()),
+         twrite("metrics.prom"))
+    step("timeline",
+         lambda: cluster_timeline(
+             address, os.path.join(out_dir, "timeline.json"),
+             timeout=budget()))
+
+    # serve control plane (needs a connected runtime; absent serve apps
+    # are an error entry, not a failure). serve.status()'s internal
+    # probes carry their own 10-30s timeouts which this step cannot
+    # shorten, so it is attempted only while a real budget remains —
+    # a hung controller must not stretch the dump to multiples of the
+    # deadline.
+    status = None
+    if deadline - time.monotonic() > 15.0:
+        status = step("serve_status", lambda: serve_status(address),
+                      jwrite("serve_status.json"))
+    else:
+        summary["errors"]["serve_status"] = "insufficient budget left"
+    if status:
+        def _llm():
+            out = {}
+            for app in status.get("apps", {}):
+                if time.monotonic() >= deadline:
+                    break
+                try:
+                    out[app] = llm_status(app, timeout=budget())
+                except Exception:  # noqa: BLE001
+                    continue  # not an LLM app (or replicas gone)
+            return out
+
+        step("llm_status", _llm, jwrite("llm_status.json"))
+
+    # per-node log tails (alive nodes only: a dead nodelet has no RPC
+    # endpoint to tail from — its logs are on its disk)
+    from ray_tpu.core.rpc import RpcClient
+
+    for n in nodes or []:
+        if not n.get("alive"):
+            summary["errors"][f"logs:{n['node_id'][:12]}"] = "node dead"
+            continue
+        nid = n["node_id"][:12]
+
+        def _tail_node(n=n, nid=nid):
+            node_dir = os.path.join(out_dir, "logs", nid)
+            os.makedirs(node_dir, exist_ok=True)
+            logs = RpcClient.shared().call(
+                n["address"], "list_logs", {},
+                timeout=budget(5.0))["logs"]
+            for entry in logs[:50]:
+                if time.monotonic() >= deadline:
+                    break
+                try:
+                    value, frames = RpcClient.shared().call_frames(
+                        n["address"], "tail_log",
+                        {"file": entry["file"],
+                         "nbytes": log_tail_bytes, "offset": -1},
+                        timeout=budget(5.0))
+                    if value.get("ok"):
+                        with open(os.path.join(node_dir, entry["file"]),
+                                  "wb") as f:
+                            f.write(frames[0])
+                except Exception:  # noqa: BLE001
+                    continue
+            return len(logs)
+
+        step(f"logs:{nid}", _tail_node)
+
+    summary["elapsed_s"] = round(time.monotonic() - t0, 3)
+    with open(os.path.join(out_dir, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=1, default=str)
+    return out_dir

@@ -77,6 +77,24 @@ def record_span(name: str, duration_s: float, category: str = "user",
                trace=trace or current_trace())
 
 
+def record_interval(name: str, t0_monotonic_s: float,
+                    t1_monotonic_s: float, category: str = "user",
+                    trace: dict | None = None) -> None:
+    """Log a span over an explicit [t0, t1] monotonic-seconds window
+    (time.monotonic() readings) — how waterfall producers lay phase
+    spans at their true positions instead of 'ending now'."""
+    _, log = _ctx_and_log()
+    log.record(name, category, int(t0_monotonic_s * 1e9),
+               int(t1_monotonic_s * 1e9), trace=trace or current_trace())
+
+
+def configure_sampling(policy: dict | None) -> None:
+    """Install a span sampling policy on this process's active span log
+    (``{"max_per_s": N, "categories": {cat: N}}``, 0 = unlimited)."""
+    _, log = _ctx_and_log()
+    log.configure_sampling(policy)
+
+
 def jit_cache_size(jit_fn) -> int:
     """Compiled-program count of a `jax.jit` callable, or -1 when the
     (private) `_cache_size` API is unavailable. The ONE wrapper around

@@ -46,11 +46,80 @@ def child_trace(parent: dict | None) -> dict:
             "parent_id": parent["span_id"]}
 
 
+class SpanSampler:
+    """Per-category span rate limiting for the >10k tasks/s regime.
+
+    Policy shape: ``{"max_per_s": float, "categories": {cat: float}}``
+    — 0 (or a missing entry) means unlimited. Token-bucket per
+    category, with one hard guarantee the tests pin: the FIRST span of
+    every distinct (category, name) pair is always kept (so a sampled
+    timeline still shows that a phase/task *exists* even when its rate
+    is clamped). Drop/keep counts are tracked per category so nothing
+    ever disappears silently.
+
+    Off by default: `admit()` is only called when a policy with a
+    nonzero limit is installed — the unsampled hot path stays one dict
+    lookup + append, exactly as before.
+    """
+
+    def __init__(self, policy: dict | None = None):
+        self.policy = policy or {}
+        self._buckets: dict[str, list[float]] = {}  # cat -> [tokens, t]
+        self._seen: set[tuple[str, str]] = set()
+
+    def limit_for(self, category: str) -> float:
+        cats = self.policy.get("categories") or {}
+        return float(cats.get(category,
+                              self.policy.get("max_per_s", 0.0)) or 0.0)
+
+    def admit(self, name: str, category: str, now: float) -> bool:
+        """Caller holds the owning log's lock."""
+        rate = self.limit_for(category)
+        if rate <= 0:
+            return True
+        key = (category, name)
+        if key not in self._seen:
+            if len(self._seen) < 8192:  # bounded first-seen memory
+                self._seen.add(key)
+                return True
+            # set full (high-cardinality names — per-task ids): the
+            # first-seen guarantee is exhausted; fall THROUGH to the
+            # bucket, or unbounded fresh names would bypass sampling
+            # entirely in exactly the flood regime this exists for
+        bucket = self._buckets.get(category)
+        if bucket is None:
+            bucket = self._buckets[category] = [rate, now]
+        tokens, t_last = bucket
+        tokens = min(rate, tokens + (now - t_last) * rate)
+        if tokens >= 1.0:
+            bucket[0] = tokens - 1.0
+            bucket[1] = now
+            return True
+        bucket[0] = tokens
+        bucket[1] = now
+        return False
+
+
 class TaskEventLog:
     def __init__(self, capacity: int = 100_000):
         self._events: list[dict] = []
         self._lock = threading.Lock()
         self._capacity = capacity
+        self._sampler: SpanSampler | None = None  # guarded_by(_lock)
+        # per-category kept/dropped counts since the last counter sync
+        # (plain ints under the existing lock: the hot path must not pay
+        # a metrics-registry lock per span)
+        self._kept: dict[str, int] = {}  # guarded_by(_lock)
+        self._dropped: dict[str, int] = {}  # guarded_by(_lock)
+
+    def configure_sampling(self, policy: dict | None) -> None:
+        """Install (or clear, with None/empty) a sampling policy:
+        ``{"max_per_s": N, "categories": {cat: N}}``, 0 = unlimited.
+        Head-driven: workers poll the head's `span_policy` and install
+        whatever it answers, so one knob at the head throttles every
+        producer."""
+        with self._lock:
+            self._sampler = SpanSampler(policy) if policy else None
 
     @contextlib.contextmanager
     def span(self, name: str, category: str, trace: dict | None = None):
@@ -68,7 +137,9 @@ class TaskEventLog:
     def record(self, name: str, category: str, t0_ns: int,
                t1_ns: int | None = None, trace: dict | None = None):
         """Append one completed span timed by the caller (monotonic_ns
-        endpoints); `ts` is epoch-anchored at append."""
+        endpoints); `ts` is epoch-anchored at append. Subject to the
+        sampling policy (when one is installed) and the capacity bound;
+        rejected spans are COUNTED per category, never silently lost."""
         if t1_ns is None:
             t1_ns = time.monotonic_ns()
         ev = {
@@ -83,8 +154,50 @@ class TaskEventLog:
         if trace:
             ev["args"] = dict(trace)
         with self._lock:
-            if len(self._events) < self._capacity:
-                self._events.append(ev)
+            if self._sampler is not None and not self._sampler.admit(
+                    name, category, t1_ns / 1e9):
+                self._dropped[category] = \
+                    self._dropped.get(category, 0) + 1
+                return
+            if len(self._events) >= self._capacity:
+                self._dropped[category] = \
+                    self._dropped.get(category, 0) + 1
+                return
+            self._kept[category] = self._kept.get(category, 0) + 1
+            self._events.append(ev)
+
+    def span_counts(self) -> tuple[dict[str, int], dict[str, int]]:
+        """(kept, dropped) per category since construction/last reset —
+        the raw numbers behind spans_sampled_total/spans_dropped_total."""
+        with self._lock:
+            return dict(self._kept), dict(self._dropped)
+
+    def sync_metrics(self) -> None:
+        """Publish kept/dropped deltas into the process metrics registry
+        (`spans_sampled_total` / `spans_dropped_total`, tagged by
+        category). Called from flush loops — NOT the record hot path —
+        so sampling accounting costs nothing per span."""
+        with self._lock:
+            kept = {k: v for k, v in self._kept.items() if v}
+            dropped = {k: v for k, v in self._dropped.items() if v}
+            self._kept.clear()
+            self._dropped.clear()
+        if not kept and not dropped:
+            return
+        from ray_tpu.util.metrics import Counter
+
+        m_kept = Counter(
+            "spans_sampled_total",
+            "Spans admitted into the local span buffer, by category",
+            tag_keys=("category",))
+        m_drop = Counter(
+            "spans_dropped_total",
+            "Spans rejected by the sampling policy or a full buffer, "
+            "by category", tag_keys=("category",))
+        for cat, n in kept.items():
+            m_kept.inc(n, tags={"category": cat})
+        for cat, n in dropped.items():
+            m_drop.inc(n, tags={"category": cat})
 
     def drain(self) -> list[dict]:
         """Take (and clear) the buffered spans — the flush primitive:
@@ -110,6 +223,94 @@ class TaskEventLog:
                 json.dump(events, f)
             return filename
         return events
+
+
+class SpanSpill:
+    """Bounded on-disk JSONL overflow for a span buffer (the head's
+    50k in-memory window used to drop history silently; now it spills).
+
+    Two-file rotation keeps the bound simple and cheap: spans append to
+    the *current* file; when it crosses half the byte budget the
+    previous file is discarded and the current one takes its place.
+    Total disk use stays under `max_bytes`, the oldest half is what
+    falls off, and no append ever rewrites a big file. Readers get
+    old-file + current-file in order. All I/O under a private lock —
+    callers must NOT hold their own buffer lock across calls (keeps
+    disk writes off the span ingest lock)."""
+
+    def __init__(self, directory: str | None = None,
+                 max_bytes: int = 64 << 20):
+        self._dir = directory
+        self._max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._cur: str | None = None  # guarded_by(_lock)
+        self._old: str | None = None  # guarded_by(_lock)
+        self._cur_bytes = 0  # guarded_by(_lock)
+        self.spilled_total = 0  # guarded_by(_lock)
+        self.rotated_total = 0  # guarded_by(_lock)
+
+    def _ensure_dir_locked(self) -> str:
+        if self._dir is None:
+            import tempfile
+
+            self._dir = tempfile.mkdtemp(prefix="ray_tpu_spans_")
+        else:
+            import os
+
+            os.makedirs(self._dir, exist_ok=True)
+        return self._dir
+
+    def append(self, spans: list[dict]) -> None:
+        if not spans:
+            return
+        import os
+
+        with self._lock:
+            d = self._ensure_dir_locked()
+            if self._cur is None:
+                self._cur = os.path.join(d, "spans.1.jsonl")
+                self._old = os.path.join(d, "spans.0.jsonl")
+            lines = []
+            for s in spans:
+                try:
+                    lines.append(json.dumps(s))
+                except (TypeError, ValueError):
+                    continue  # unserializable span: drop just this one
+            blob = ("\n".join(lines) + "\n").encode()
+            try:
+                with open(self._cur, "ab") as f:
+                    f.write(blob)
+            except OSError:
+                return  # disk trouble: spill is best-effort overflow
+            self._cur_bytes += len(blob)
+            self.spilled_total += len(lines)
+            if self._cur_bytes > self._max_bytes // 2:
+                try:
+                    os.replace(self._cur, self._old)
+                except OSError:
+                    pass
+                self._cur_bytes = 0
+                self.rotated_total += 1
+
+    def read(self) -> list[dict]:
+        """Spilled spans, oldest first (old file then current)."""
+        out: list[dict] = []
+        with self._lock:
+            paths = [p for p in (self._old, self._cur) if p]
+        for path in paths:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            out.append(json.loads(line))
+                        except ValueError:
+                            continue
+            except OSError:
+                continue
+        return out
 
 
 def merge_spans(spans: list[dict], filename: str | None = None):

@@ -1,0 +1,31 @@
+"""GL011 firing fixture: exceptions escaping oneway handlers."""
+
+
+class Service:
+    def __init__(self, server):
+        self.server = server
+        server.register("task_done", self._h_task_done, oneway=True)
+        server.register("heartbeat", self._h_heartbeat, oneway=True)
+
+    def _h_task_done(self, msg, frames):
+        if "task_id" not in msg:
+            raise ValueError("missing task_id")  # FIRE: nobody sees it
+        self._done = msg["task_id"]
+
+    def _h_heartbeat(self, msg, frames):
+        assert msg.get("node_id"), "beat without node"  # FIRE: swallowed
+        try:
+            self._beat = float(msg["t"])
+        except KeyError:
+            raise RuntimeError("no timestamp")  # FIRE: escapes the except
+
+
+def wire(server):
+    server.register("free_object", handler, True)  # positional oneway
+    return server
+
+
+def handler(msg, frames):
+    if not msg:
+        raise KeyError("empty free")  # FIRE: registered oneway above
+    return None

@@ -1,0 +1,30 @@
+"""GL011 non-firing fixture: handled errors + two-way raisers."""
+
+
+class Service:
+    def __init__(self, server):
+        self.server = server
+        server.register("task_done", self._h_task_done, oneway=True)
+        server.register("resolve", self._h_resolve)  # two-way: raise ok
+
+    def _h_task_done(self, msg, frames):
+        try:
+            if "task_id" not in msg:
+                raise ValueError("missing task_id")  # caught below
+            self._done = msg["task_id"]
+        except Exception as e:  # noqa: BLE001
+            self._log(e)  # handled locally: the sanctioned idiom
+
+    def _h_resolve(self, msg, frames):
+        def helper():
+            raise RuntimeError("nested scope, not the handler")
+
+        if not msg:
+            raise KeyError("two-way handlers reply with errors")
+        return helper()
+
+    def _h_unregistered(self, msg, frames):
+        raise RuntimeError("never registered oneway: quiet")
+
+    def _log(self, e):
+        self.last_error = repr(e)
