@@ -371,6 +371,39 @@ class ClusterRuntime:
         oid = ObjectID.random()
         b = oid.binary()
         st = _Owned()
+        self._seal_owned(st, b, value)
+        st.event.set()
+        with self._lock:
+            self._owned[b] = st
+        return ObjectRef(oid, owner=self.address)
+
+    def deferred(self):
+        """A promise: (ref, fulfill, reject). Registers an owned object
+        whose value arrives later via the callbacks — the ref is
+        get-able (and borrowable) immediately, blocking until sealed,
+        exactly like a task-return oid awaiting task_done. Serve
+        handles use this to front retried submits (failover relays)
+        with one stable ref."""
+        oid = ObjectID.random()
+        b = oid.binary()
+        st = _Owned()
+        with self._lock:
+            self._owned[b] = st
+
+        def fulfill(value):
+            self._seal_owned(st, b, value)
+            st.event.set()
+
+        def reject(e: BaseException):
+            st.error = e
+            st.event.set()
+
+        return ObjectRef(oid, owner=self.address), fulfill, reject
+
+    def _seal_owned(self, st: "_Owned", b: bytes, value) -> None:
+        """Serialize `value` into an owned slot (inline or store tier)
+        without setting its event — put()/deferred() own the visibility
+        flip."""
         head_payload, views, total = ser.serialize(value)
         st.size = total
         if total <= INLINE_THRESHOLD or self.store is None:
@@ -401,10 +434,6 @@ class ClusterRuntime:
                 st.inline = bytes(buf)
         st.value_cached = value
         st.has_cached = True
-        st.event.set()
-        with self._lock:
-            self._owned[b] = st
-        return ObjectRef(oid, owner=self.address)
 
     # ------------------------------------------------------------ spilling
     # Owner-driven disk tier (reference: raylet LocalObjectManager,
@@ -898,6 +927,11 @@ class ClusterRuntime:
                     return {"status": "unknown"}  # freed while we waited
                 if st.spilled_path is not None:
                     try:
+                        # justified GL012: the spilled read must stay
+                        # atomic with the ownership re-check above — a
+                        # concurrent free/un-spill outside the lock
+                        # could unlink the file between check and read
+                        # graftlint: disable=blocking-under-lock
                         with open(st.spilled_path, "rb") as f:
                             return {"status": "inline"}, [f.read()]
                     except OSError:

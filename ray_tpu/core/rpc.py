@@ -15,7 +15,12 @@ status: b"K" ok, b"E" error (payload = pickled exception).
 
 Fault injection (reference: rpc/rpc_chaos.h): set
 RAY_TPU_TESTING_RPC_FAILURE="method=N" and the client will drop the
-first N sends of `method`, exercising retry paths deterministically.
+first N sends of `method`, exercising retry paths deterministically;
+"method=delayN" instead delivers the first N sends LATE — by
+RAY_TPU_TESTING_RPC_DELAY_S seconds (default 1.0), from a timer thread
+— the slow-network shape that turns health probes into timeouts
+without killing anything (the straggler reply is ignored by the
+already-popped msg_id).
 """
 
 from __future__ import annotations
@@ -47,7 +52,8 @@ class PeerUnavailableError(RpcError):
 # ---------------------------------------------------------------- chaos
 
 _chaos_lock = threading.Lock()
-_chaos_budget: dict[str, int] = {}
+# method -> (action, remaining budget); action is "drop" or "delay"
+_chaos_budget: dict[str, list] = {}
 
 
 def _chaos_init():
@@ -56,8 +62,12 @@ def _chaos_init():
     for part in spec.split(","):
         if "=" in part:
             m, n = part.split("=", 1)
+            n = n.strip()
+            action = "drop"
+            if n.startswith("delay"):
+                action, n = "delay", n[len("delay"):]
             try:
-                out[m.strip()] = int(n)
+                out[m.strip()] = [action, int(n)]
             except ValueError:
                 pass
     return out
@@ -67,8 +77,9 @@ _chaos_budget = _chaos_init()
 
 
 def set_chaos(spec: str):
-    """(Re)arm deterministic RPC drop budgets in THIS process at runtime
-    (tests; same format as the env var: "method=N,method2=M"). Reference:
+    """(Re)arm deterministic RPC fault budgets in THIS process at
+    runtime (tests; same format as the env var: "method=N" drops the
+    first N sends, "method=delayN" delays them instead). Reference:
     rpc/rpc_chaos.h:23."""
     global _chaos_budget
     os.environ["RAY_TPU_TESTING_RPC_FAILURE"] = spec
@@ -76,15 +87,41 @@ def set_chaos(spec: str):
         _chaos_budget = _chaos_init()
 
 
-def _chaos_should_drop(method: str) -> bool:
+def _chaos_delay_s() -> float:
+    try:
+        return float(os.environ.get("RAY_TPU_TESTING_RPC_DELAY_S", "1.0"))
+    except ValueError:
+        return 1.0
+
+
+def _chaos_action(method: str) -> str | None:
+    """Consume one unit of `method`'s fault budget: "drop", "delay", or
+    None when no budget is armed."""
     if not _chaos_budget:
-        return False
+        return None
     with _chaos_lock:
-        n = _chaos_budget.get(method, 0)
-        if n > 0:
-            _chaos_budget[method] = n - 1
-            return True
-    return False
+        ent = _chaos_budget.get(method)
+        if ent is not None and ent[1] > 0:
+            ent[1] -= 1
+            return ent[0]
+    return None
+
+
+def _chaos_send_late(send, parts) -> None:
+    """Deliver `parts` after the chaos delay, from a timer thread: the
+    caller's timeout races a message that is in flight but late — the
+    deterministic slow-network shape (the late reply is ignored by the
+    already-popped msg_id, exactly like a real straggler)."""
+
+    def fire():
+        try:
+            send(parts)
+        except Exception:  # noqa: BLE001
+            pass  # peer closed while the message was 'in the air'
+
+    t = threading.Timer(_chaos_delay_s(), fire)
+    t.daemon = True
+    t.start()
 
 
 # ------------------------------------------------------ socket ownership
@@ -537,9 +574,14 @@ class RpcClient:
         fut: Future = Future()
         with peer.pending_lock:
             peer.pending[msg_id] = fut
-        if _chaos_should_drop(method):
+        action = _chaos_action(method)
+        if action == "drop":
             return msg_id, fut  # simulated drop: caller's timeout/retry fires
         payload = ser.dumps_msg(msg or {})
+        if action == "delay":
+            _chaos_send_late(peer.send,
+                             [msg_id, method.encode(), payload, *frames])
+            return msg_id, fut
         try:
             peer.send([msg_id, method.encode(), payload, *frames])
         except PeerUnavailableError:
@@ -621,9 +663,16 @@ class RpcClient:
 
     def send_oneway(self, address: str, method: str, msg: dict | None = None,
                     frames: list = ()):
-        if _chaos_should_drop(method):
+        action = _chaos_action(method)
+        if action == "drop":
             return
         payload = ser.dumps_msg(msg or {})
+        if action == "delay":
+            peer = self._peer(address)
+            _chaos_send_late(
+                peer.send, [b"\x00" * 8, method.encode(), payload,
+                            *frames])
+            return
         from ray_tpu.core import config as cfg
 
         window_ms = cfg.get("ONEWAY_BATCH_WINDOW_MS")

@@ -161,6 +161,16 @@ class LocalRuntime:
         self._slot(oid).set_value(value)
         return ObjectRef(oid)
 
+    def deferred(self):
+        """A promise: (ref, fulfill, reject). The ref behaves like any
+        owned object — `get` blocks until one of the callbacks runs.
+        Serve handles use this to front a retried submit with ONE ref
+        whose result may come from a different replica than the first
+        attempt (failover relays)."""
+        oid = ObjectID.random()
+        s = self._slot(oid)
+        return ObjectRef(oid), s.set_value, s.set_error
+
     def get(self, refs: list[ObjectRef], timeout=None):
         deadline = None if timeout is None else time.monotonic() + timeout
         out = []

@@ -1,0 +1,150 @@
+"""GL012: blocking I/O or RPC while holding a ``guarded_by`` lock.
+
+The locks named in ``# guarded_by(<lock>)`` annotations are, by
+declaration, the locks every thread in the process contends on to
+touch shared state. Sleeping, waiting on a remote result, or doing an
+RPC round-trip while holding one turns a microsecond critical section
+into a seconds-long convoy: every handler thread that needs the lock
+parks behind one slow network peer, and the component's event loop
+reads as "stalled" (the serve controller's health loop is the
+motivating shape — probe RPCs must happen on a SNAPSHOT taken under
+the lock, never under it).
+
+Fires on, lexically inside a ``with <lock>:`` where ``<lock>`` is
+named by any guarded_by annotation in the same class (or module scope,
+for GL010-style module locks):
+
+- ``time.sleep(...)``
+- ``ray_tpu.get(...)`` / ``ray_tpu.wait(...)`` (remote results)
+- ``.call(...)`` / ``.call_frames(...)`` / ``.call_gather(...)`` on a
+  receiver whose path mentions ``client``, or on ``RpcClient.shared()``
+  (the RPC round-trip idiom)
+- timeout-less ``.result()`` (future join)
+- builtin ``open(...)`` (file I/O; spill paths stage under the lock and
+  write outside it)
+
+The snapshot-then-act pattern (copy under the lock, call outside) is
+the sanctioned fix. ``Condition.wait`` is NOT flagged — it releases
+the lock while parked, which is the whole point of conditions.
+Justified exceptions use ``# graftlint: disable=blocking-under-lock``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ray_tpu.devtools.context import ModuleContext, qualname
+from ray_tpu.devtools.registry import Rule, register
+
+_ANNOT_RE = re.compile(r"#.*?guarded_by\(\s*(?:self\.)?([\w\.]+)\s*\)")
+
+_RPC_METHODS = {"call", "call_frames", "call_gather"}
+_BLOCKING_RESOLVED = {"time.sleep", "ray_tpu.get", "ray_tpu.wait",
+                      "open"}
+
+
+@register
+class BlockingUnderLockRule(Rule):
+    name = "blocking-under-lock"
+    code = "GL012"
+    description = ("blocking I/O / RPC / sleep while holding a lock "
+                   "named by a guarded_by annotation")
+    invariant = ("guarded_by critical sections stay short: no thread "
+                 "holding shared-state locks parks on the network, the "
+                 "disk, or a timer")
+    interests = ("Call",)
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        # (scope, lock qualname) seen in guarded_by annotations; scope
+        # is the class name ("" at module level). Collected up front
+        # from the raw lines — annotations are comments, invisible to
+        # the AST walk.
+        self._locks: set[tuple[str, str]] = set()
+        self._events: list[tuple] = []
+        self._enabled = "guarded_by(" in ctx.source
+        if not self._enabled:
+            return
+        self._collect_annotations(ctx)
+
+    def _collect_annotations(self, ctx: ModuleContext) -> None:
+        """Map each guarded_by comment line to its enclosing class by
+        AST position (module scope for top-level annotations)."""
+        spans: list[tuple[int, int, str]] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                spans.append((node.lineno,
+                              getattr(node, "end_lineno", node.lineno),
+                              node.name))
+        for i, line in enumerate(ctx.lines, start=1):
+            m = _ANNOT_RE.search(line)
+            if not m:
+                continue
+            lock = m.group(1)
+            scope = ""
+            best = None
+            for lo, hi, name in spans:
+                if lo <= i <= hi and (best is None or lo > best[0]):
+                    best = (lo, name)
+            if best is not None:
+                scope = best[1]
+            if "." not in lock or lock.startswith("self."):
+                # class-scope locks are self attributes
+                qual = lock if lock.startswith("self.") else (
+                    f"self.{lock}" if scope else lock)
+                self._locks.add((scope, qual))
+            else:
+                self._locks.add((scope, lock))
+
+    # ---------------------------------------------------------------- visit
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if not self._enabled or not ctx.lock_stack:
+            return
+        label = self._blocking_label(node, ctx)
+        if label is None:
+            return
+        scope = ctx.current_class.name if ctx.current_class else ""
+        self._events.append((scope, tuple(ctx.lock_stack), node, label))
+
+    def _blocking_label(self, node: ast.Call, ctx: ModuleContext
+                        ) -> str | None:
+        f = node.func
+        if isinstance(f, (ast.Name, ast.Attribute)):
+            qn = qualname(f)
+            if qn is not None and ctx.resolve(qn) in _BLOCKING_RESOLVED:
+                return ctx.resolve(qn)
+        if isinstance(f, ast.Attribute):
+            if f.attr in _RPC_METHODS:
+                recv = qualname(f.value)
+                if recv is not None and "client" in recv.lower():
+                    return f"{recv}.{f.attr}"
+                if isinstance(f.value, ast.Call):
+                    inner = qualname(f.value.func)
+                    if inner is not None and \
+                            inner.endswith("RpcClient.shared"):
+                        return f"RpcClient.shared().{f.attr}"
+            if f.attr == "result" and not node.args and \
+                    not node.keywords:
+                return "Future.result() without timeout"
+        return None
+
+    # ------------------------------------------------------------ end pass
+
+    def end_module(self, ctx: ModuleContext) -> None:
+        if not self._enabled:
+            return
+        for scope, held, node, label in self._events:
+            guarded = [lock for s, lock in self._locks
+                       if s == scope and lock in held]
+            if not guarded:
+                # module-scope guarded locks apply everywhere in the
+                # module (GL010 globals are shared process-wide)
+                guarded = [lock for s, lock in self._locks
+                           if s == "" and lock in held]
+            if not guarded:
+                continue
+            ctx.report(self, node,
+                       f"{label} while holding {guarded[0]} (a "
+                       f"guarded_by lock) — snapshot under the lock, "
+                       f"block outside it")

@@ -128,6 +128,15 @@ class LLMServer:
     def engine_stats(self) -> dict:
         return self.engine.stats()
 
+    def check_health(self) -> str:
+        """Controller health probe hook (rides the replica's control
+        concurrency group): a replica whose step loop died is alive as
+        a process but can never finish a stream — report it unhealthy
+        so the self-healing loop replaces it."""
+        if self._alive and not self._loop.is_alive():
+            raise RuntimeError("engine step loop died")
+        return "ok"
+
     def ping(self) -> str:
         return "pong"
 

@@ -128,6 +128,20 @@ CATALOG: list[dict] = [
      "where": "ray_tpu/serve/api.py", "what": "HTTP ingress, by status"},
     {"name": "serve_http_request_latency_ms", "type": "histogram",
      "where": "ray_tpu/serve/api.py", "what": "HTTP ingress latency"},
+    # serve self-healing
+    {"name": "serve_replica_health_checks_total", "type": "counter",
+     "where": "ray_tpu/serve/api.py",
+     "what": "controller health probes, by result (ok|miss|dead)"},
+    {"name": "serve_replica_restarts_total", "type": "counter",
+     "where": "ray_tpu/serve/api.py",
+     "what": "replica replacements started by the self-healing loop"},
+    {"name": "serve_replicas_healthy", "type": "gauge",
+     "where": "ray_tpu/serve/api.py",
+     "what": "replicas passing their latest health probe round"},
+    {"name": "serve_request_failovers_total", "type": "counter",
+     "where": "ray_tpu/serve/api.py",
+     "what": "requests re-submitted after replica death (unary "
+             "retries + mid-stream resumes)"},
     # RL flywheel
     {"name": "rl_rollout_tokens_total", "type": "counter",
      "where": "ray_tpu/rllib/llm/rollout.py",

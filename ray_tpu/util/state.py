@@ -238,11 +238,18 @@ def summarize(address: str | None = None) -> dict:
 
 
 def serve_status(address: str | None = None) -> dict:
-    """Serve apps + per-proxy request metrics (reference: `ray serve
-    status` / the serve state surface). The serve control plane lives in
-    actors, so this needs a runtime: with `address` given it connects to
-    that head when no runtime exists, and refuses to silently answer
-    from a DIFFERENT cluster than the one asked about."""
+    """Serve apps + per-replica health + per-proxy request metrics
+    (reference: `ray serve status` / the serve state surface). The
+    ``health`` key carries the self-healing plane's per-app view —
+    live replicas with probe-miss counts, restart totals, degraded
+    flags, and the bounded replica lifecycle history (deaths with
+    reasons, replacements, restart-cap events) — which is what
+    ``debug_dump`` persists as ``serve_status.json``, so a post-mortem
+    can reconstruct WHEN each replica died and why. The serve control
+    plane lives in actors, so this needs a runtime: with `address`
+    given it connects to that head when no runtime exists, and refuses
+    to silently answer from a DIFFERENT cluster than the one asked
+    about."""
     import ray_tpu
     from ray_tpu import serve
 

@@ -278,6 +278,12 @@ class SpanSpill:
                     continue  # unserializable span: drop just this one
             blob = ("\n".join(lines) + "\n").encode()
             try:
+                # justified GL012: SpanSpill._lock exists to serialize
+                # exactly this append/rotate pair — concurrent appenders
+                # outside it would interleave half-lines into the JSONL;
+                # the lock is private to the spill (the head's span
+                # buffer lock is NOT held here)
+                # graftlint: disable=blocking-under-lock
                 with open(self._cur, "ab") as f:
                     f.write(blob)
             except OSError:

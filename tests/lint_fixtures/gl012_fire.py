@@ -1,0 +1,26 @@
+"""GL012 firing fixture: blocking calls under a guarded_by lock."""
+import time
+import threading
+
+import ray_tpu
+
+
+class Controller:
+    def __init__(self, client):
+        self._lock = threading.Lock()
+        self._replicas = []  # guarded_by(_lock)
+        self.client = client
+
+    def probe(self):
+        with self._lock:
+            for r in self._replicas:
+                ray_tpu.get(r)  # FIRE: remote result under the lock
+
+    def settle(self):
+        with self._lock:
+            time.sleep(0.5)  # FIRE: timer under the lock
+            self._replicas.clear()
+
+    def scrape(self, address):
+        with self._lock:
+            return self.client.call(address, "stats", {})  # FIRE: RPC

@@ -1,0 +1,33 @@
+"""GL012 non-firing fixture: snapshot under the lock, block outside;
+blocking under an UN-annotated lock is someone else's contract."""
+import time
+import threading
+
+import ray_tpu
+
+
+class Controller:
+    def __init__(self, client):
+        self._lock = threading.Lock()
+        self._replicas = []  # guarded_by(_lock)
+        self._io_lock = threading.Lock()  # not guarded_by-annotated
+        self.client = client
+
+    def probe(self):
+        with self._lock:
+            replicas = list(self._replicas)  # snapshot...
+        return [ray_tpu.get(r) for r in replicas]  # ...block outside
+
+    def settle(self):
+        with self._lock:
+            self._replicas.clear()
+        time.sleep(0.5)  # timer outside the critical section
+
+    def scrape(self, address):
+        with self._io_lock:  # a plain serialization lock is fine
+            return self.client.call(address, "stats", {})
+
+    def sized_read(self, fut):
+        with self._lock:
+            n = len(self._replicas)
+        return fut.result(), n  # future join outside the lock

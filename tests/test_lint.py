@@ -40,6 +40,7 @@ RULE_CASES = [
     ("GL009", "lock-order", "gl009_fire.py", "gl009_ok.py", 3),
     ("GL010", "global-guarded-by", "gl010_fire.py", "gl010_ok.py", 3),
     ("GL011", "oneway-exception", "gl011_fire.py", "gl011_ok.py", 4),
+    ("GL012", "blocking-under-lock", "gl012_fire.py", "gl012_ok.py", 3),
 ]
 
 
@@ -61,7 +62,7 @@ def test_rule_catalog_complete():
     catalog = rule_catalog()
     assert [c.code for c in catalog] == [
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-        "GL008", "GL009", "GL010", "GL011"]
+        "GL008", "GL009", "GL010", "GL011", "GL012"]
     for cls in catalog:
         assert cls.name and cls.description and cls.invariant
 
