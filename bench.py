@@ -94,13 +94,20 @@ def _ppo_bench_subprocess() -> dict:
 
 
 
-def _time_steps(step, state, batch, mesh, warmup: int, steps: int):
+def _time_steps(step, state, batch, mesh, warmup: int, steps: int,
+                profile_dir: str | None = None):
     """Warmup, then time `steps` compiled steps. Sync via a device-to-
     host copy of the loss — block_until_ready is not a reliable barrier
-    on every PJRT plugin. Returns (state, final_loss, seconds)."""
+    on every PJRT plugin. `profile_dir` arms a device-profiler capture
+    window around exactly the TIMED steps (no warmup/compile noise in
+    the capture; guarded no-op on CPU). Returns (state, final_loss,
+    seconds, captured) — `captured` is the REAL capture path, or None
+    when nothing was armed/written (CPU, or profiler unavailable), so
+    run metadata never points at a directory that does not exist."""
     import time as _time
 
     from ray_tpu.train import spmd
+    from ray_tpu.util import tracing as _tracing
 
     # at least one warmup step: it also binds `metrics` for the sync read
     warmup = max(1, warmup)
@@ -111,12 +118,13 @@ def _time_steps(step, state, batch, mesh, warmup: int, steps: int):
         # attribution runs (--trace): the table covers the TIMED steps
         # only, so phase totals compare against `dt` directly
         spmd.waterfall.reset()
-        t0 = _time.perf_counter()
-        for _ in range(steps):
-            state, metrics = step(state, batch)
-        final_loss = float(metrics["loss"])
-        dt = _time.perf_counter() - t0
-    return state, final_loss, dt
+        with _tracing.profiler_capture(profile_dir) as captured:
+            t0 = _time.perf_counter()
+            for _ in range(steps):
+                state, metrics = step(state, batch)
+            final_loss = float(metrics["loss"])
+            dt = _time.perf_counter() - t0
+    return state, final_loss, dt, captured
 
 
 def main(trace: str | None = None):
@@ -180,9 +188,16 @@ def main(trace: str | None = None):
     batch = jax.device_put(batch, batch_shardings(mesh, batch))
 
     step = make_train_step(lambda p, b: gpt2_loss(p, b, cfg), tx)
+    # --trace on TPU also arms a device-side profiler capture around
+    # exactly the timed steps (jax.profiler.trace; guarded no-op on
+    # CPU) — the in-program attribution (GEMM vs collective) the
+    # host-side waterfall cannot see. Path lands in the run metadata
+    # below and on the chrome trace as the profiler.capture span.
+    profile_dir = f"{trace}.profile" if (trace and on_tpu) else None
     with tracing.span("bench.gpt2", category="bench"):
-        state, final_loss, dt = _time_steps(step, state, batch, mesh,
-                                            warmup, steps)
+        state, final_loss, dt, captured = _time_steps(
+            step, state, batch, mesh, warmup, steps,
+            profile_dir=profile_dir)
     # per-phase attribution of the timed gpt2 steps (--trace runs):
     # phases sum to ~dt, so the percents decompose the MFU number
     attribution = spmd.waterfall.summary() if trace else None
@@ -214,8 +229,8 @@ def main(trace: str | None = None):
         lbatch = {"tokens": ltoks[:, :-1], "targets": ltoks[:, 1:]}
         lbatch = jax.device_put(lbatch, batch_shardings(mesh, lbatch))
         lstep = make_train_step(lambda p, b: llama_loss(p, b, lcfg), tx)
-        lstate, _lloss, ldt = _time_steps(lstep, lstate, lbatch, mesh,
-                                          warmup, steps)
+        lstate, _lloss, ldt, _ = _time_steps(lstep, lstate, lbatch,
+                                             mesh, warmup, steps)
         llama_per_chip = B * seq * steps / ldt / n
 
     # GPT-2-XL-class single-chip config (VERDICT r3 item 2): E=2048 is
@@ -239,8 +254,8 @@ def main(trace: str | None = None):
         xbatch = {"tokens": xtoks[:, :-1], "targets": xtoks[:, 1:]}
         xbatch = jax.device_put(xbatch, batch_shardings(mesh, xbatch))
         xstep = make_train_step(lambda p, b: gpt2_loss(p, b, xcfg), tx)
-        xstate, _xl_loss, xdt = _time_steps(xstep, xstate, xbatch, mesh,
-                                            2, 10)
+        xstate, _xl_loss, xdt, _ = _time_steps(xstep, xstate, xbatch,
+                                               mesh, 2, 10)
         xl_per_chip = xB * seq * 10 / xdt / n
         xl_mfu = 6.0 * xp * xl_per_chip / 197e12
         del xstate, xbatch
@@ -302,6 +317,7 @@ def main(trace: str | None = None):
                     "ppo_env_steps_per_sec_max":
                         round(ppo.get("max", 0.0)),
                     "step_attribution": attribution,
+                    "profiler_capture": captured,
                 },
             }
         )

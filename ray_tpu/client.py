@@ -221,6 +221,11 @@ class _ClientHost:
             self.rt.shutdown()
         except Exception:  # noqa: BLE001
             pass
+        # lease returns are SYNCHRONOUS inside shutdown() (the reply is
+        # the delivery guarantee); this beat only gives zmq's io thread
+        # a chance to push the remaining best-effort oneways (frees,
+        # disconnect acks) before the process dies
+        time.sleep(0.1)
         os._exit(0)
 
     def serve_forever(self, idle_timeout_s: float = 300.0):

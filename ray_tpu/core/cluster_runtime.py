@@ -2253,12 +2253,28 @@ class ClusterRuntime:
         with self._lock:
             held = [le for pool in self._lease_pools.values() for le in pool]
             self._lease_pools.clear()
-        for le in held:
+        if held:
+            # SYNCHRONOUS returns under ONE shared deadline: callers
+            # like the client host os._exit right after shutdown()
+            # returns, and a oneway still sitting in the batcher (or
+            # zmq's io thread) at exit silently strands every leased
+            # worker on the nodelet until the 30s lease TTL reclaims
+            # it — the test_client.test_wait wedge: 4 dead drivers'
+            # stale leases saturated a 4-worker pool. The replies are
+            # the delivery guarantee; dead nodelets cost 2s TOTAL
+            # (call_gather reclaims timed-out slots).
             try:
-                self.client.send_oneway(le.nodelet, "return_lease",
-                                        {"lease_id": le.lease_id})
+                self.client.call_gather(
+                    [(le.nodelet, "return_lease",
+                      {"lease_id": le.lease_id}) for le in held],
+                    timeout=2)
             except Exception:  # noqa: BLE001
                 pass
+        # queued frees still ride the batcher — flush before exit paths
+        try:
+            self.client.flush_oneways()
+        except Exception:  # noqa: BLE001
+            pass
         self.server.stop()
         for oid in list(self._pins):
             self._release_pin(oid)

@@ -50,7 +50,11 @@ class Head:
                  span_capacity: int = 50_000,
                  span_spill_dir: str | None = None,
                  span_spill_max_bytes: int = 64 << 20,
-                 span_rate_limit: float | None = None):
+                 span_rate_limit: float | None = None,
+                 watchtower_period_s: float | None = None,
+                 watchtower_rules: list | None = None,
+                 watchtower_autodump: str | bool | None = None,
+                 watchtower_autodump_cooldown_s: float | None = None):
         from ray_tpu.core.head_storage import InMemoryHeadStore
 
         self.server = RpcServer(name="head", num_threads=32)
@@ -143,7 +147,23 @@ class Head:
         # dump or metrics scrape never starves heartbeats
         s.register("dump_timeline", self._h_dump_timeline, slow=True)
         s.register("cluster_metrics", self._h_cluster_metrics, slow=True)
+        s.register("metrics_history", self._h_metrics_history, slow=True)
+        s.register("alerts", self._h_alerts)
         s.register("ping", lambda m, f: "pong")
+        # watchtower: the always-on consumer of the scrape fan-out —
+        # metric history, SLO rules, alerts, alert-triggered dumps. Its
+        # sampling loop is the head's own thread (period_s apart), so
+        # history/alerting never touches a request hot path.
+        from ray_tpu.util.watchtower import Watchtower
+
+        self.watchtower = Watchtower(
+            scrape=self._cluster_metrics_text,
+            period_s=watchtower_period_s,
+            rules=watchtower_rules,
+            autodump=watchtower_autodump,
+            autodump_cooldown_s=watchtower_autodump_cooldown_s,
+            address_fn=lambda: self.address,
+            span_sink=self._ingest_spans)
         self._monitor = threading.Thread(target=self._monitor_loop, daemon=True,
                                          name="head-monitor")
         self._pg_retry = threading.Thread(target=self._pg_retry_loop,
@@ -190,6 +210,7 @@ class Head:
         self._monitor.start()
         self._pg_retry.start()
         self._persister.start()
+        self.watchtower.start()
         return self
 
     def _enqueue_persist(self, op: str, table: str, key, value=None):
@@ -223,6 +244,7 @@ class Head:
                     break
             time.sleep(0.02)
         self._stopped.set()
+        self.watchtower.stop()
         self.server.stop()
 
     # ------------------------------------------------------------ nodes
@@ -654,6 +676,21 @@ class Head:
 
     def _h_cluster_metrics(self, msg, frames):
         return {"text": self._cluster_metrics_text()}
+
+    def _h_metrics_history(self, msg, frames):
+        """The watchtower's retained time series (bounded ring buffers
+        over the periodic cluster scrape). Read-only over state the
+        sampling thread already gathered — this handler must NEVER call
+        back into its own server's handler pool (the GL013 self-deadlock
+        shape; the fan-out happened on the watchtower thread)."""
+        return self.watchtower.history_dict(
+            msg.get("names"), msg.get("window_s"))
+
+    def _h_alerts(self, msg, frames):
+        """Active alerts + bounded transition history + the rule pack.
+        Same read-only discipline as metrics_history."""
+        return self.watchtower.alerts_dict(
+            include_history=msg.get("history", True))
 
     def start_metrics_http(self, port: int = 0) -> int:
         """Serve the cluster-wide /metrics page over HTTP from the head

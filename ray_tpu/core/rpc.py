@@ -748,6 +748,14 @@ class RpcClient:
                 except PeerUnavailableError:
                     pass  # best-effort
 
+    def flush_oneways(self):
+        """Force-flush coalesced oneways NOW. Senders about to exit the
+        process (a driver's shutdown returning worker leases) cannot
+        wait for the batch window's flusher thread — an os._exit right
+        after send_oneway() would strand the batch in the buffer and
+        the messages would silently never leave the process."""
+        self._flush_oneways()
+
     def drop_peer(self, address: str):
         with self._lock:
             p = self._peers.pop(address, None)

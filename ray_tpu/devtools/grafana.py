@@ -34,6 +34,7 @@ _GROUPS = (
     ("serve", "Serve proxy"),
     ("rl", "RL flywheel"),
     ("spans", "Span plane"),
+    ("watchtower", "Alerts"),
 )
 
 

@@ -11,6 +11,7 @@ from ray_tpu.devtools.rules import (  # noqa: F401
     except_hygiene,
     global_guard,
     guarded_by,
+    handler_reentry,
     host_transfer,
     lock_order,
     oneway_raise,

@@ -1,0 +1,109 @@
+"""GL013: an RPC handler that synchronously calls back into its own
+server's handler pool.
+
+An ``RpcServer`` dispatches handlers on a bounded thread pool. A handler
+that does a synchronous ``.call(...)`` against its OWN server's address
+needs a second pool thread to answer it — fine under light load, a
+deterministic self-deadlock the moment the pool is saturated: every
+pool thread is parked inside the outer handler waiting for an inner
+dispatch that can never be scheduled, and the server wedges until the
+client timeout cascades. The bug ships green (tests rarely saturate the
+pool) and surfaces as a cluster-wide stall under exactly the load spike
+the handler was built for.
+
+Heuristic (lexical, same scoping as GL008/GL011): collect handler
+functions registered via ``<server>.register("method", self._h_x, ...)``
+(first argument a string literal — so ``atexit.register(fn)`` and
+one-argument registries never match), then flag, in those functions'
+own bodies, any ``.call`` / ``.call_frames`` / ``.call_gather`` whose
+target resolves to the server's own address — a first argument of
+``self.address`` or ``self.server.address``, including inside a literal
+``call_gather`` target list. The sanctioned shapes: do the fan-out on a
+NON-handler thread and have the handler read the gathered state (the
+head's watchtower/metrics_history split), or ``send_oneway`` (no reply
+to park on), or move the work to a different process/server.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools.context import ModuleContext, qualname
+from ray_tpu.devtools.registry import Rule, register
+
+_RPC_METHODS = {"call", "call_frames", "call_gather"}
+_SELF_ADDRS = {"self.address", "self.server.address"}
+
+
+def _handler_name(expr: ast.expr) -> str | None:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _targets_self(arg: ast.expr) -> bool:
+    """Does this call target (an address expression, or a call_gather
+    [(addr, method, msg), ...] literal list) name the server's own
+    address?"""
+    qn = qualname(arg)
+    if qn in _SELF_ADDRS:
+        return True
+    if isinstance(arg, (ast.List, ast.Tuple)):
+        for elt in arg.elts:
+            if isinstance(elt, ast.Tuple) and elt.elts and \
+                    qualname(elt.elts[0]) in _SELF_ADDRS:
+                return True
+    return False
+
+
+@register
+class HandlerReentryRule(Rule):
+    name = "handler-reentry"
+    code = "GL013"
+    description = ("RPC handler synchronously calls back into its own "
+                   "server's handler pool (self-deadlock when the pool "
+                   "is saturated)")
+    invariant = ("handler-pool threads never park waiting on a dispatch "
+                 "that needs one of those same threads")
+    interests = ("Call",)
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        # (class scope, handler fn name) registered on an RPC server
+        self._handlers: set[tuple[str, str]] = set()
+        # (class scope, enclosing fn name, call node) self-targeted RPCs
+        self._events: list[tuple[str, str, ast.Call]] = []
+        self._enabled = ".register(" in ctx.source
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if not self._enabled or not isinstance(node.func, ast.Attribute):
+            return
+        scope = ctx.current_class.name if ctx.current_class else ""
+        f = node.func
+        if f.attr == "register" and len(node.args) >= 2 and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            name = _handler_name(node.args[1])
+            if name is not None:
+                self._handlers.add((scope, name))
+            return
+        if f.attr in _RPC_METHODS and node.args and \
+                _targets_self(node.args[0]):
+            fn = ctx.current_function
+            if fn is not None:
+                self._events.append((scope, fn.name, node))
+
+    def end_module(self, ctx: ModuleContext) -> None:
+        for scope, fn_name, node in self._events:
+            if (scope, fn_name) not in self._handlers:
+                continue
+            method = node.func.attr  # type: ignore[union-attr]
+            ctx.report(self, node,
+                       f"{fn_name} is a registered RPC handler doing a "
+                       f"synchronous .{method}() against its own "
+                       "server's address — with the pool saturated "
+                       "every thread parks waiting for a dispatch that "
+                       "needs one of them (self-deadlock); gather on a "
+                       "non-handler thread and let the handler read "
+                       "the result, or send_oneway")

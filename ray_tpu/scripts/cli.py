@@ -10,6 +10,7 @@ Reference parity: python/ray/scripts/scripts.py — `ray start --head`,
   python -m ray_tpu.scripts.cli list {actors|nodes|pgs} --address ...
   python -m ray_tpu.scripts.cli timeline --address HOST:PORT -o out.json
   python -m ray_tpu.scripts.cli metrics  --address HOST:PORT
+  python -m ray_tpu.scripts.cli alerts   --address HOST:PORT [--json]
   python -m ray_tpu.scripts.cli debug-dump --address HOST:PORT [-o DIR]
   python -m ray_tpu.scripts.cli stop   [--session-dir DIR]
 """
@@ -165,6 +166,43 @@ def cmd_metrics(args):
     return 0
 
 
+def cmd_alerts(args):
+    """Watchtower alerts: active pending/firing alerts plus the recent
+    transition history (the same facts `util.state.alerts()` returns
+    and `watchtower_alerts_firing{severity}` gauges on the metrics
+    page)."""
+    from ray_tpu.util import state
+
+    data = state.alerts(address=args.address)
+    if args.json:
+        print(json.dumps(data, indent=2, default=str))
+        return 0
+    active = sorted(data.get("alerts", ()),
+                    key=lambda a: (a["state"], a["rule"]))
+    if not active:
+        print(f"no active alerts ({len(data.get('rules', ()))} rules "
+              "watching)")
+    else:
+        print(f"{'RULE':<24} {'SEV':<9} {'STATE':<8} {'VALUE':>12} "
+              f"{'THRESHOLD':>12}  SINCE")
+        for a in active:
+            since = time.strftime("%H:%M:%S",
+                                  time.localtime(a["since"]))
+            print(f"{a['rule']:<24} {a['severity']:<9} "
+                  f"{a['state']:<8} {a['value']:>12.4g} "
+                  f"{a['threshold']:>12.4g}  {since}")
+    history = data.get("history", ())
+    if history:
+        print(f"--- last {min(len(history), args.limit)} transitions ---")
+        for ev in list(history)[-args.limit:]:
+            t = time.strftime("%H:%M:%S", time.localtime(ev["t"]))
+            value = (f" value={ev['value']:.4g}"
+                     if ev.get("value") is not None else "")
+            print(f"  {t} {ev['rule']:<24} "
+                  f"{ev['from'] or '-':<9}-> {ev['to']:<9}{value}")
+    return 0
+
+
 def cmd_debug_dump(args):
     """Flight recorder: one post-mortem directory — state listings,
     memory report, serve/llm status, merged timeline, cluster metrics,
@@ -307,6 +345,14 @@ def main(argv=None):
                                        "Prometheus metrics page")
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("alerts", help="print watchtower alerts "
+                                      "(active + recent transitions)")
+    p.add_argument("--address", required=True)
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--limit", type=int, default=20,
+                   help="transition-history lines to show")
+    p.set_defaults(fn=cmd_alerts)
 
     p = sub.add_parser("debug-dump",
                        help="write a one-call post-mortem directory "

@@ -165,6 +165,25 @@ CATALOG: list[dict] = [
     {"name": "spans_dropped_total", "type": "counter",
      "where": "ray_tpu/utils/events.py",
      "what": "spans rejected (sampling policy or full buffer)"},
+    # watchtower (alerting plane)
+    {"name": "watchtower_alerts_firing", "type": "gauge",
+     "where": "ray_tpu/util/watchtower.py",
+     "what": "alerts currently firing, by severity"},
+    {"name": "watchtower_alerts_total", "type": "counter",
+     "where": "ray_tpu/util/watchtower.py",
+     "what": "pending->firing transitions, by rule"},
+    {"name": "watchtower_samples_total", "type": "counter",
+     "where": "ray_tpu/util/watchtower.py",
+     "what": "metric-history sample ticks completed"},
+    {"name": "watchtower_series", "type": "gauge",
+     "where": "ray_tpu/util/watchtower.py",
+     "what": "metric-history series retained"},
+    {"name": "watchtower_series_dropped_total", "type": "counter",
+     "where": "ray_tpu/util/watchtower.py",
+     "what": "new series rejected by the history series cap"},
+    {"name": "watchtower_autodumps_total", "type": "counter",
+     "where": "ray_tpu/util/watchtower.py",
+     "what": "debug dumps auto-triggered by critical alerts"},
 ]
 
 

@@ -63,6 +63,39 @@ def cluster_metrics(address: str | None = None,
                       timeout=timeout)["text"]
 
 
+def cluster_metrics_history(names=None, window_s: float | None = None,
+                            address: str | None = None,
+                            timeout: float = 30) -> dict:
+    """The head watchtower's retained metric time series: the head
+    samples its own cluster-wide scrape every few seconds (default 5s)
+    into bounded per-series ring buffers, so rate/derivative questions
+    ("is the queue ramping?", "did TTFT p99 move in the last 10min?")
+    have history to run against — the substrate an SLO autoscaler
+    consumes. Returns ``{"series": [{name, tags, samples: [[epoch_s,
+    value], ...]}], "period_s", "series_count", "series_dropped",
+    "samples_total"}``; `names` filters to those metric names,
+    `window_s` clips to the trailing window. Memory is bounded by a
+    series cap (rejected new series are COUNTED in
+    ``series_dropped``) times a per-series ring."""
+    return _head_call("metrics_history",
+                      {"names": list(names) if names else None,
+                       "window_s": window_s},
+                      address=address, timeout=timeout)
+
+
+def alerts(address: str | None = None, include_history: bool = True,
+           timeout: float = 30) -> dict:
+    """The watchtower's structured alerts: ``{"alerts": [...],
+    "history": [...], "rules": [...], "autodumps": N}`` — active
+    (pending/firing) alerts, the bounded transition history
+    (pending→firing→resolved events), and the rule pack being
+    evaluated. The same facts surface as
+    ``watchtower_alerts_firing{severity}`` on the cluster metrics page
+    and through ``ray_tpu alerts``."""
+    return _head_call("alerts", {"history": include_history},
+                      address=address, timeout=timeout)
+
+
 def cluster_timeline(address: str | None = None,
                      filename: str | None = None, timeout: float = 30):
     """The merged cluster chrome trace from the head's span buffer
@@ -319,6 +352,7 @@ def debug_dump(out_dir: str | None = None, address: str | None = None,
         <dir>/llm_status.json           per-replica engine stats
         <dir>/timeline.json             merged chrome trace
         <dir>/metrics.prom              cluster Prometheus page
+        <dir>/alerts.json               watchtower alerts + transitions
         <dir>/logs/<node12>/<file>      per-node log tails
     """
     import json
@@ -386,6 +420,8 @@ def debug_dump(out_dir: str | None = None, address: str | None = None,
          twrite("memory.txt"))
     step("metrics", lambda: cluster_metrics(address, timeout=budget()),
          twrite("metrics.prom"))
+    step("alerts", lambda: alerts(address, timeout=budget()),
+         jwrite("alerts.json"))
     step("timeline",
          lambda: cluster_timeline(
              address, os.path.join(out_dir, "timeline.json"),

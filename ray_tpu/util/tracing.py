@@ -95,6 +95,46 @@ def configure_sampling(policy: dict | None) -> None:
     log.configure_sampling(policy)
 
 
+@contextlib.contextmanager
+def profiler_capture(out_dir: str | None):
+    """Arm a `jax.profiler.trace` capture window around the enclosed
+    block — the device-side (TPU) profile that attributes in-program
+    time (collective vs. GEMM vs. copy) the host-side span plane cannot
+    see. Guarded no-op on CPU and when `out_dir` is falsy, so bench
+    drivers call it unconditionally: on TPU a `--trace` run captures N
+    timed steps, on CPU nothing is armed and nothing is written.
+
+    The capture window rides the span API: a `profiler.capture` span
+    (category `profiler`) covers the armed block, and its trace args
+    carry the capture path — so the chrome timeline records WHERE the
+    device profile for that window lives. Yields the capture directory
+    (None when not armed)."""
+    if not out_dir:
+        # genuinely free no-op: no jax import, no backend init
+        yield None
+        return
+    import jax
+
+    if jax.devices()[0].platform in ("cpu",):
+        yield None
+        return
+    try:
+        profile = jax.profiler.trace(out_dir)
+        profile.__enter__()
+    except Exception:  # noqa: BLE001  # profiler unavailable on this
+        yield None  # backend/build: the bench still runs, un-profiled
+        return
+    with span("profiler.capture", category="profiler") as trace:
+        trace["capture_path"] = out_dir
+        try:
+            yield out_dir
+        finally:
+            try:
+                profile.__exit__(None, None, None)
+            except Exception:  # noqa: BLE001
+                pass  # a failed stop must not eat the bench result
+
+
 def jit_cache_size(jit_fn) -> int:
     """Compiled-program count of a `jax.jit` callable, or -1 when the
     (private) `_cache_size` API is unavailable. The ONE wrapper around

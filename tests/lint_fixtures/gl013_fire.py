@@ -1,0 +1,30 @@
+"""GL013 firing fixture: handlers calling back into their own server."""
+
+
+class Service:
+    def __init__(self, server, client):
+        self.server = server
+        self.client = client
+        self.address = server.address
+        server.register("stats", self._h_stats)
+        server.register("chain", self._h_chain)
+        server.register("fan", self._h_fan)
+        server.register("leaf", self._h_leaf)
+
+    def _h_stats(self, msg, frames):
+        # FIRE: synchronous self-call — needs a second pool thread
+        return self.client.call(self.address, "leaf", {})
+
+    def _h_chain(self, msg, frames):
+        # FIRE: same deadlock through the server's own address attribute
+        value, fr = self.client.call_frames(self.server.address,
+                                            "leaf", {}, timeout=5)
+        return value
+
+    def _h_fan(self, msg, frames):
+        # FIRE: gather list that includes this server itself
+        return self.client.call_gather(
+            [(self.address, "leaf", {})], timeout=5)
+
+    def _h_leaf(self, msg, frames):
+        return {}

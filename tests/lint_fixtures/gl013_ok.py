@@ -1,0 +1,37 @@
+"""GL013 clean twin: handlers that talk to PEERS (or not at all)."""
+
+import threading
+
+
+class Service:
+    def __init__(self, server, client):
+        self.server = server
+        self.client = client
+        self.address = server.address
+        server.register("relay", self._h_relay)
+        server.register("notify", self._h_notify)
+        server.register("snapshot", self._h_snapshot)
+        self._gathered = {}
+
+    def _h_relay(self, msg, frames):
+        # ok: a DIFFERENT peer answers from its own pool
+        return self.client.call(msg["peer"], "leaf", {})
+
+    def _h_notify(self, msg, frames):
+        # ok: oneway has no reply — nothing parks on the pool
+        self.client.send_oneway(self.address, "event", {})
+        return {}
+
+    def _h_snapshot(self, msg, frames):
+        # ok: reads state a non-handler thread gathered
+        return dict(self._gathered)
+
+    def _refresh_loop(self):
+        # ok: not a handler — a dedicated thread may call its own
+        # server (one parked thread, pool still drains)
+        while True:
+            self._gathered = self.client.call(self.address,
+                                              "snapshot", {})
+
+    def start(self):
+        threading.Thread(target=self._refresh_loop, daemon=True).start()
