@@ -22,6 +22,7 @@ from __future__ import annotations
 import atexit
 import hashlib
 import os
+import random
 import threading
 import time
 from typing import Any, Callable
@@ -35,7 +36,12 @@ from ray_tpu.core.head import dataclass_dict
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_store import open_store
 from ray_tpu.core.options import ActorOptions, TaskOptions
-from ray_tpu.core.rpc import PeerUnavailableError, RpcClient, RpcServer
+from ray_tpu.core.rpc import (
+    Batcher,
+    PeerUnavailableError,
+    RpcClient,
+    RpcServer,
+)
 from ray_tpu.core.specs import INLINE_THRESHOLD, ActorSpec, RefArg, TaskSpec
 from ray_tpu.utils.events import TaskEventLog, child_trace, merge_spans
 
@@ -124,12 +130,44 @@ class _HeldLease:
         self.nodelet = nodelet  # which nodelet granted (return/renew here)
 
 
-# max in-flight pushes per leased worker: one executing + one buffered
-# at the worker keeps the wire full without committing a backlog to a
-# single worker (excess waits CLIENT-side where it can still move to
-# newly granted leases on other nodes)
-_LEASE_PIPELINE_DEPTH = 2
+# max in-flight pushes per leased worker: enough buffered at the worker
+# to keep the wire full AND let refills ride one batched frame, without
+# committing the whole backlog to a single worker (excess waits
+# CLIENT-side where it can still move to newly granted leases on other
+# nodes). Config LEASE_PIPELINE_DEPTH.
+def _lease_depth() -> int:
+    from ray_tpu.core import config as cfg
+
+    return max(1, int(cfg.get("LEASE_PIPELINE_DEPTH")))
+
+
 _LEASE_IDLE_RETURN_S = 2.0
+
+# core_submit_coalesced_total{kind}: items that rode a coalesced frame
+# (lazy-constructed: this module loads before the metrics package can)
+_coalesced_counter = None
+_coalesced_lock = threading.Lock()
+
+
+def _submit_coalesced(kind: str, n: int):
+    global _coalesced_counter
+    if _coalesced_counter is None:
+        with _coalesced_lock:
+            if _coalesced_counter is None:
+                try:
+                    from ray_tpu.util.metrics import Counter
+
+                    _coalesced_counter = Counter(
+                        "core_submit_coalesced_total",
+                        "submissions/returns that rode a coalesced "
+                        "batch frame, by kind",
+                        tag_keys=("kind",))
+                except Exception:  # noqa: BLE001
+                    return
+    try:
+        _coalesced_counter.inc(n, {"kind": kind})
+    except Exception:  # noqa: BLE001
+        pass
 
 
 def _ack_timeout() -> float:
@@ -208,10 +246,18 @@ class ClusterRuntime:
 
         # streaming-generator streams we own, keyed by producing task_id
         self._streams: dict[bytes, _StreamState] = {}  # guarded_by(_lock)
+        # submit-side coalescer: pending task/actor-call submissions to
+        # the same peer pack into ONE batched RPC frame (adaptive flush:
+        # size-capped inline, idle window, and force-flushed by every
+        # path about to block on a result)
+        self._submit_batcher = Batcher(f"rt-{mode}-submit",
+                                       self._flush_submit_batch)
         self.server = RpcServer(name=f"rt-{mode}", num_threads=32)
         self.server.register("lease_broken", self._h_lease_broken,
                              oneway=True)
         self.server.register("task_done", self._h_task_done, oneway=True)
+        self.server.register("task_done_batch", self._h_task_done_batch,
+                             oneway=True)
         self.server.register("resolve", self._h_resolve)
         self.server.register("stream_item", self._h_stream_item, oneway=True)
         self.server.register("stream_end", self._h_stream_end, oneway=True)
@@ -514,6 +560,7 @@ class ClusterRuntime:
         return freed
 
     def get(self, refs: list[ObjectRef], timeout=None):
+        self.flush_submits()  # about to block: no batch may sit buffered
         deadline = None if timeout is None else time.monotonic() + timeout
         return [self._get_one(r, deadline) for r in refs]
 
@@ -535,7 +582,7 @@ class ClusterRuntime:
                     raise exc.GetTimeoutError(
                         f"get() timed out waiting for {ref}")
                 if st.error is not None:
-                    raise st.error
+                    self._raise_stored(st.error)
                 if st.has_cached:
                     return st.value_cached
                 if st.spilled_path is not None:
@@ -795,6 +842,7 @@ class ClusterRuntime:
             self.store.release(oid)
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        self.flush_submits()
         deadline = None if timeout is None else time.monotonic() + timeout
         pending = list(refs)
         ready = []
@@ -829,6 +877,7 @@ class ClusterRuntime:
     def as_future(self, ref: ObjectRef):
         import concurrent.futures as cf
 
+        self.flush_submits()
         fut = cf.Future()
 
         def waiter():
@@ -1013,6 +1062,15 @@ class ClusterRuntime:
                 st.size = loc.get("size", 0)
             st.event.set()
 
+    def _h_task_done_batch(self, msg, frames):
+        """N task_done messages from one worker in one frame (the
+        return-path half of the submit coalescer). Frames arrive
+        concatenated in entry order; counts[i] slices them back out."""
+        off = 0
+        for ent, n in zip(msg["entries"], msg["counts"]):
+            self._h_task_done(ent, frames[off:off + n])
+            off += n
+
     def _task_failed(self, oids, error, retryable) -> bool:
         spec = None
         with self._lock:
@@ -1099,6 +1157,7 @@ class ClusterRuntime:
         """Block until item `index` of the stream exists; return its
         ObjectRef. Raises StopIteration at end-of-stream, the producer's
         error past the last yielded item, or GetTimeoutError."""
+        self.flush_submits()
         deadline = None if timeout is None else time.monotonic() + timeout
         if owner == self.address:
             return self._stream_next_local(task_id, index, deadline)
@@ -1154,7 +1213,7 @@ class ClusterRuntime:
                     ended = True
                     break
                 if stream.error is not None:
-                    raise stream.error
+                    self._raise_stored(stream.error)
                 rem = (None if deadline is None
                        else deadline - time.monotonic())
                 if rem is not None and rem <= 0:
@@ -1549,9 +1608,13 @@ class ClusterRuntime:
                                      {"spec": dataclass_dict(spec)},
                                      timeout=60, retries=2)
             else:
-                self.client.call(target, "schedule_task",
-                                 {"spec": dataclass_dict(spec)},
-                                 timeout=60, retries=2)
+                # plain/pg/label tasks ride the submit coalescer: N
+                # specs to the same nodelet pack into one
+                # schedule_tasks frame (was: one SYNCHRONOUS
+                # schedule_task round trip per task); delivery errors
+                # surface on the returned refs via the ack sweeper
+                self._submit_batcher.append(("schedule_tasks", target),
+                                            spec)
         # the submit span makes the DRIVER visible on the merged timeline
         # and shares the task's trace context with the executor-side span
         self._events.record(f"submit:{spec.name}", "submit", t_submit0,
@@ -1564,6 +1627,85 @@ class ClusterRuntime:
         if n == 0:
             return []
         return refs[0] if n == 1 else refs
+
+    # -------------------------------------------------- submit coalescing
+
+    def flush_submits(self):
+        """Force-flush coalesced submissions NOW. Called by every path
+        about to BLOCK on a result (get/wait/stream iteration): the
+        adaptive batch window must never sit on a latency-critical
+        path — a sync call's submit leaves the process before its
+        owner starts waiting."""
+        self._submit_batcher.flush()
+
+    def _flush_submit_batch(self, key, entries):
+        """Batcher flush hook: one call_async per (kind, peer) batch,
+        acked as a unit through the submit sweeper."""
+        kind = key[0]
+        if kind == "actor_calls":
+            addr = key[1]
+            fut = self.client.call_async(
+                addr, "actor_calls", {"calls": [e[0] for e in entries]})
+
+            def fail():
+                for _msg, ab, task_id, obids in entries:
+                    self._actor_push_failed(ab, task_id, obids)
+
+            with self._lock:
+                self._pending_acks.append(
+                    [time.monotonic() + _ack_timeout(), fut, None, fail])
+            _submit_coalesced("actor_call", len(entries))
+        elif kind == "schedule_tasks":
+            self._send_schedule_batch(key[1], list(entries))
+            _submit_coalesced("task", len(entries))
+        elif kind == "execute_leased":
+            # entries share one lease (it is part of the key)
+            lease = entries[0][0]
+            self._push_leased(lease, [e[1] for e in entries])
+            _submit_coalesced("lease", len(entries))
+
+    def _send_schedule_batch(self, addr: str, specs: list, acks_left=2):
+        """Push one batched schedule_tasks frame; the submit sweeper
+        resends on a lost ack (nodelet-side (task_id, attempt) dedup
+        absorbs a slow-but-delivered original) and fails the tasks
+        retryably once resends are exhausted."""
+        fut = self.client.call_async(
+            addr, "schedule_tasks",
+            {"specs": [dataclass_dict(s) for s in specs]})
+
+        def resend():
+            self._send_schedule_batch(addr, specs, acks_left - 1)
+
+        def fail():
+            for s in specs:
+                self._task_failed(
+                    s.return_oids,
+                    exc.WorkerCrashedError(
+                        f"task submission to {addr} failed"),
+                    retryable=True)
+
+        with self._lock:
+            self._pending_acks.append(
+                [time.monotonic() + _ack_timeout(), fut, resend,
+                 fail if acks_left <= 0 else None])
+
+    def _actor_push_failed(self, ab: bytes, task_id: bytes, obids: list):
+        """An actor-call push never got its enqueue ack: worker presumed
+        gone. First-writer-wins with task_done (a completed call whose
+        ack reply was merely lost stays completed)."""
+        with self._lock:
+            done = task_id not in self._task_actor
+            pend = self._inflight_actor.get(ab)
+            if pend is not None:
+                pend.pop(task_id, None)
+            self._task_actor.pop(task_id, None)
+            self._actor_addr.pop(ab, None)  # force re-resolve next call
+        if not done:
+            err = exc.ActorUnavailableError(
+                "actor call delivery failed (no enqueue ack)")
+            self._error_oids(obids, err)
+            self._stream_fail(task_id, err)
+            self._unpin_task_args(task_id)
 
     # locality only kicks in above this many serialized arg bytes — tiny
     # args are cheaper to move than a cross-node scheduling decision
@@ -1632,11 +1774,19 @@ class ClusterRuntime:
                 with self._lock:
                     self._lease_backoff[key] = now + 0.05
         with self._lock:
+            # SUBMIT-time commits cap at 2 (one executing + one
+            # buffered): a burst must stay CLIENT-side where it can
+            # still move to newly granted leases on other nodes (the
+            # autoscaler's scale-up feeds on exactly that mobility).
+            # Only the completion-driven refill path (_refill_lease)
+            # fills the full pipeline depth — a lease that is visibly
+            # consuming tasks has earned a deep pipe.
+            depth = min(2, _lease_depth())
             if lease is None or lease.broken:
                 lease = min(
                     (le for le in pool
                      if not le.broken
-                     and len(le.inflight) < _LEASE_PIPELINE_DEPTH),
+                     and len(le.inflight) < depth),
                     key=lambda le: len(le.inflight), default=None)
             if lease is None:
                 pending.append(spec)
@@ -1644,25 +1794,47 @@ class ClusterRuntime:
             lease.inflight.add(spec.task_id)
             lease.last_active = time.monotonic()
             self._task_lease[spec.task_id] = (lease, spec)
-        self._push_leased(lease, spec)
+        self._queue_leased_push(lease, spec)
         return True
 
     def _refill_lease(self, lease: _HeldLease):
-        """A slot freed on this lease: push the next client-queued task
+        """Slots freed on this lease: push the next client-queued tasks
         (the OnWorkerIdle moment — keeps the pipe full without a sweeper
-        round trip)."""
+        round trip). Refills up to the pipeline depth and the whole
+        refill rides ONE batched execute_leased frame."""
         with self._lock:
-            if lease.broken or \
-                    len(lease.inflight) >= _LEASE_PIPELINE_DEPTH:
-                return
+            depth = _lease_depth()
             pending = self._lease_pending.get(lease.key)
-            if not pending:
+            if lease.broken or not pending:
                 return
-            spec = pending.pop(0)
-            lease.inflight.add(spec.task_id)
+            if len(pending) <= depth:
+                # SMALL backlog: keep it shallow (old depth-2 shape) so
+                # the remainder stays client-side where the sweeper can
+                # still move it to new capacity (autoscaler scale-up);
+                # a deep pipe is only worth committing when the backlog
+                # dwarfs what any one worker could absorb anyway. An
+                # operator depth BELOW 2 still binds.
+                depth = min(2, depth)
+            gap = depth - len(lease.inflight)
+            if gap <= 0:
+                return
+            specs = pending[:gap]
+            del pending[:gap]
+            for spec in specs:
+                lease.inflight.add(spec.task_id)
+                self._task_lease[spec.task_id] = (lease, spec)
             lease.last_active = time.monotonic()
-            self._task_lease[spec.task_id] = (lease, spec)
-        self._push_leased(lease, spec)
+        for spec in specs:
+            self._queue_leased_push(lease, spec)
+
+    def _queue_leased_push(self, lease: _HeldLease, spec: TaskSpec):
+        """Leased pushes ride the submit coalescer too: a tight submit
+        loop's inline pushes (a lease with free depth takes every spec
+        immediately) pack into multi-spec execute_leased frames instead
+        of one zmq frame per task — the single biggest per-task cost on
+        the steady-state path."""
+        self._submit_batcher.append(
+            ("execute_leased", id(lease), lease.address), (lease, spec))
 
     def _request_lease(self, key: tuple, spec: TaskSpec):
         """Ask the local nodelet for a worker lease, following spillback
@@ -1718,28 +1890,36 @@ class ClusterRuntime:
             except Exception:  # noqa: BLE001
                 pass
 
-    def _push_leased(self, lease: _HeldLease, spec: TaskSpec,
+    def _push_leased(self, lease: _HeldLease, specs: list,
                      acks_left: int = 2):
+        """Push up to a pipeline-depth's worth of specs to the leased
+        worker in ONE execute_leased frame (one socket write, one
+        shared enqueue-ack); worker-side (task_id, attempt) dedup makes
+        resends of the whole frame harmless."""
         if acks_left == 2 and lease.nodelet != self.nodelet_address:
-            self._prefetch_args(lease.nodelet, spec)
-        fut = self.client.call_async(lease.address, "execute_leased",
-                                     {"spec": dataclass_dict(spec),
-                                      "attempt": spec.attempt,
-                                      "lease_id": lease.lease_id})
+            for spec in specs:
+                self._prefetch_args(lease.nodelet, spec)
+        fut = self.client.call_async(
+            lease.address, "execute_leased",
+            {"specs": [dataclass_dict(s) for s in specs],
+             "attempts": [s.attempt for s in specs],
+             "lease_id": lease.lease_id})
 
         def resend():
-            self._push_leased(lease, spec, acks_left - 1)
+            self._push_leased(lease, specs, acks_left - 1)
 
         def fail():
-            # enqueue-ack never arrived: worker presumed gone; the task
-            # becomes a retryable failure (dedup at the worker makes a
+            # enqueue-ack never arrived: worker presumed gone; the tasks
+            # become retryable failures (dedup at the worker makes a
             # slow-but-delivered original harmless)
-            self._lease_task_failed(lease, spec)
+            for spec in specs:
+                self._lease_task_failed(lease, spec)
 
         def stale():
             # rejected BEFORE execution (StaleLeaseError): never charge
             # the retry budget and never resend to the dead lease
-            self._lease_task_requeue(lease, spec)
+            for spec in specs:
+                self._lease_task_requeue(lease, spec)
 
         with self._lock:
             self._pending_acks.append(
@@ -1878,8 +2058,7 @@ class ClusterRuntime:
                 with self._lock:
                     self._lease_backoff[key] = now + 0.5
             else:
-                for _ in range(_LEASE_PIPELINE_DEPTH):
-                    self._refill_lease(lease)
+                self._refill_lease(lease)  # fills to depth in one frame
         if self.nodelet_address and (backlog or self._last_backlog):
             self._last_backlog = backlog
             try:
@@ -2046,6 +2225,11 @@ class ClusterRuntime:
             refs = [ObjectRef(o, owner=self.address) for o in oids]
             return refs[0] if n == 1 else refs
         last_err = None
+        # the whole retry loop shares ONE deadline (the submission-ack
+        # budget): backoff sleeps and per-attempt RPC timeouts both
+        # shrink to the remaining budget, so opt-in retries never hold
+        # the caller past the window a single delivery attempt gets
+        deadline = time.monotonic() + _ack_timeout()
         for attempt in range(tries):
             try:
                 addr = self._resolve_actor(ab)
@@ -2062,7 +2246,17 @@ class ClusterRuntime:
                     [o.binary() for o in oids]
                 self._task_actor[task_id] = ab
             try:
-                self.client.call(addr, "actor_call", msg, timeout=30)
+                # flush coalesced pushes to this worker first so the
+                # direct call cannot overtake buffered earlier calls
+                self._submit_batcher.flush(("actor_calls", addr))
+                # each attempt gets an equal slice of the REMAINING
+                # budget: a dropped first send can never starve the
+                # retries of their window (worker-side task_id dedup
+                # keeps a slow-but-delivered original exactly-once)
+                per_attempt = max(
+                    1.0, (deadline - time.monotonic()) / (tries - attempt))
+                self.client.call(addr, "actor_call", msg,
+                                 timeout=min(30.0, per_attempt))
                 last_err = None
                 break
             except PeerUnavailableError as e:
@@ -2073,7 +2267,15 @@ class ClusterRuntime:
                         pend.pop(task_id, None)
                     self._task_actor.pop(task_id, None)
                     self._actor_addr.pop(ab, None)  # force re-resolve
-                time.sleep(0.2)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                # exponential backoff with jitter (was a flat 0.2s):
+                # doubling desyncs a retry herd hammering one restarting
+                # actor, the jitter keeps clients from re-aligning
+                delay = min(0.05 * (2 ** attempt), 2.0)
+                delay *= 0.5 + random.random()
+                time.sleep(min(delay, remaining))
         if last_err is not None:
             self._error_oids(
                 [o.binary() for o in oids],
@@ -2089,8 +2291,9 @@ class ClusterRuntime:
         # 20k; runaway submit loops must not queue unbounded memory)
         while True:
             with self._lock:
-                if len(self._pending_acks) < 10000:
-                    break
+                n_acks = len(self._pending_acks)
+            if n_acks + self._submit_batcher.pending_count() < 10000:
+                break
             time.sleep(0.001)
         obids = [o.binary() for o in oids]
         try:
@@ -2104,30 +2307,38 @@ class ClusterRuntime:
         with self._lock:
             self._inflight_actor.setdefault(ab, {})[task_id] = obids
             self._task_actor[task_id] = ab
-        fut = self.client.call_async(addr, "actor_call", msg)
-
-        def fail():
-            with self._lock:
-                done = task_id not in self._task_actor
-                pend = self._inflight_actor.get(ab)
-                if pend is not None:
-                    pend.pop(task_id, None)
-                self._task_actor.pop(task_id, None)
-                self._actor_addr.pop(ab, None)  # force re-resolve next call
-            if not done:
-                err = exc.ActorUnavailableError(
-                    "actor call delivery failed (no enqueue ack)")
-                self._error_oids(obids, err)
-                self._stream_fail(task_id, err)
-                self._unpin_task_args(task_id)
-
-        with self._lock:
-            self._pending_acks.append(
-                [time.monotonic() + _ack_timeout(), fut, None, fail])
+        # the push rides the submit coalescer: N calls to the same
+        # worker become ONE actor_calls frame with one shared
+        # enqueue-ack (was: one encode + one socket write + one ack
+        # entry per call). Per-actor order is preserved: one buffer per
+        # worker address, flushed FIFO under the batcher lock, and the
+        # worker enqueues a frame's calls in order from one dispatch.
+        self._submit_batcher.append(("actor_calls", addr),
+                                    (msg, ab, task_id, obids))
         self._events.record(f"submit:{msg['method']}", "actor_submit",
                             t_submit0, trace=msg.get("trace"))
 
+    @staticmethod
+    def _raise_stored(error: BaseException):
+        """Re-raise an error retained in owner state as a FRESH copy.
+
+        Raising the stored object directly would attach a traceback to
+        it whose frames reference the very ObjectRefs being fetched —
+        a cycle rooted in _owned that pins their refcounts forever
+        (stranded oids). A pickled round trip raises a tb-free clone,
+        like the reference deserializing a new RayTaskError per get."""
+        try:
+            fresh = ser.loads_msg(ser.dumps_msg(error))
+        except Exception:  # noqa: BLE001
+            error.__traceback__ = None  # last resort: never pin frames
+            fresh = error
+        raise fresh
+
     def _error_oids(self, oids, error):
+        # strip any traceback picked up on the way here: stored
+        # exceptions must never retain submit-path frames (they
+        # reference the submitted refs — see _raise_stored)
+        error.__traceback__ = None
         for b in oids:
             with self._lock:
                 st = self._owned.get(b)
@@ -2247,6 +2458,10 @@ class ClusterRuntime:
             return
         self._shutdown_flag = True
         atexit.unregister(self.shutdown)
+        try:
+            self._submit_batcher.close()  # coalesced submits leave now
+        except Exception:  # noqa: BLE001
+            pass
         self._flush_deferred_sends()  # don't drop queued frees
         # hand leased workers back (the nodelet's TTL would reclaim them,
         # but a clean return keeps the pool warm for the next driver)

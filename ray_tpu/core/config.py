@@ -23,6 +23,15 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
                                "coalesce small oneways per peer for this "
                                "window (0 = send each immediately)"),
     "ONEWAY_BATCH_MAX": (int, 128, "flush a oneway batch at this size"),
+    "SUBMIT_BATCH_MAX": (int, 64,
+                         "coalesce up to this many task/actor-call "
+                         "submissions into one RPC frame per peer"),
+    "SUBMIT_BATCH_WINDOW_MS": (float, 1.0,
+                               "idle-flush window for coalesced "
+                               "submissions (0 = send each immediately)"),
+    "LEASE_PIPELINE_DEPTH": (int, 8,
+                             "max in-flight pushes per leased worker "
+                             "(refills ride one batched frame)"),
     "TESTING_RPC_FAILURE": (str, "", "chaos: 'method=N,...' drop budgets"),
     # --- head
     "HEARTBEAT_INTERVAL_S": (float, 0.5, "nodelet->head resource heartbeat"),

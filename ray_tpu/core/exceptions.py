@@ -21,6 +21,12 @@ class TaskError(RayTpuError):
 
     @staticmethod
     def from_exception(e: BaseException, task_desc: str = "") -> "TaskError":
+        if isinstance(e, TaskError):
+            # an errored ObjectRef consumed as an argument re-raises the
+            # ORIGINAL task's error — never re-wrapped per hop, so a
+            # chain of N stages surfaces one TaskError with the root
+            # cause (reference: RayTaskError args pass through as-is)
+            return e
         return TaskError(e, traceback.format_exc(), task_desc)
 
     def __str__(self):

@@ -212,6 +212,7 @@ class Nodelet:
 
         s = self.server
         s.register("schedule_task", self._h_schedule_task)
+        s.register("schedule_tasks", self._h_schedule_tasks)
         s.register("start_actor", self._h_start_actor)
         s.register("stop_actor", self._h_stop_actor)
         s.register("worker_ready", self._h_worker_ready)
@@ -854,6 +855,14 @@ class Nodelet:
             pass
 
     # ------------------------------------------------------------ scheduling
+
+    def _h_schedule_tasks(self, msg, frames):
+        """Batched plain-task submission — the submit coalescer's frame:
+        one dispatch runs N schedule_task placement decisions (dedup,
+        local queue, or spillback each, exactly like the singleton
+        handler)."""
+        return {"queued": [self._h_schedule_task({"spec": s}, ())["queued"]
+                           for s in msg["specs"]]}
 
     def _h_schedule_task(self, msg, frames):
         spec = TaskSpec(**msg["spec"])

@@ -124,6 +124,154 @@ def _chaos_send_late(send, parts) -> None:
     t.start()
 
 
+# ------------------------------------------------------------ coalescing
+
+_batch_size_hist = None
+_batch_hist_lock = threading.Lock()
+
+
+def _observe_batch_size(n: int):
+    """Record one flushed batch's size into the rpc_oneway_batch_size
+    histogram (lazy: rpc.py loads before the metrics registry package
+    can, so the metric is constructed on first flush)."""
+    global _batch_size_hist
+    if _batch_size_hist is None:
+        with _batch_hist_lock:
+            if _batch_size_hist is None:
+                try:
+                    from ray_tpu.util.metrics import Histogram
+
+                    _batch_size_hist = Histogram(
+                        "rpc_oneway_batch_size",
+                        "messages coalesced per flushed batch frame",
+                        boundaries=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+                except Exception:  # noqa: BLE001
+                    return  # metrics plane unavailable: stay silent
+    try:
+        _batch_size_hist.observe(n)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+class Batcher:
+    """Generic submit-side coalescer — the oneway batcher's machinery
+    made reusable for other hot paths (batched task/actor-call
+    submission, batched task_done returns).
+
+    Per-key buffers with an ADAPTIVE flush: size-triggered (a buffer
+    reaching the max flushes inline on the appending thread — a tight
+    submit loop pays one frame per max_items), idle-triggered (a daemon
+    flusher sweeps stragglers after the window — fire-and-forget callers
+    never strand a batch), and force-flushable (`flush()` — callers
+    about to BLOCK on a result flush first, so latency-bound shapes pay
+    zero window latency).
+
+    `flush_fn(key, entries)` runs UNDER the batcher lock so per-key
+    batches leave in append order and two flushes can never interleave
+    on the wire (same rule as the oneway batcher); it must therefore be
+    non-blocking (call_async/send_oneway are NOBLOCK-or-enqueue).
+    """
+
+    def __init__(self, name: str, flush_fn,
+                 max_items_flag: str = "SUBMIT_BATCH_MAX",
+                 window_ms_flag: str = "SUBMIT_BATCH_WINDOW_MS",
+                 observe_sizes: bool = False):
+        self._name = name
+        self._flush_fn = flush_fn
+        self._buf: dict = {}  # key -> [entry, ...]; guarded_by(_lock)
+        self._pending = 0  # buffered entries; guarded_by(_lock)
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._closed = False
+        self._max_flag = max_items_flag
+        self._window_flag = window_ms_flag
+        self._observe = observe_sizes
+
+    def _max_items(self) -> int:
+        from ray_tpu.core import config as cfg
+
+        return max(1, int(cfg.get(self._max_flag)))
+
+    def append(self, key, entry):
+        """Buffer one entry for `key`; flushes inline when the key's
+        buffer reaches the size cap."""
+        from ray_tpu.core import config as cfg
+
+        flush_now = False
+        wake = False
+        immediate = float(cfg.get(self._window_flag)) <= 0
+        with self._lock:
+            buf = self._buf.setdefault(key, [])
+            buf.append(entry)
+            self._pending += 1
+            if immediate or len(buf) >= self._max_items() or self._closed:
+                # window 0 = send each immediately (same contract as
+                # the oneway batcher's flag)
+                flush_now = True
+            else:
+                if self._thread is None or not self._thread.is_alive():
+                    self._thread = threading.Thread(
+                        target=self._flush_loop, daemon=True,
+                        name=f"{self._name}-flush")
+                    self._thread.start()
+                # wake the sweeper only on the FIRST entry of a cycle:
+                # a futex wake per append is measurable on the submit
+                # hot path, and one wake arms the whole window anyway
+                wake = len(buf) == 1
+        if flush_now:
+            self.flush(key)
+        elif wake and not self._wake.is_set():
+            self._wake.set()
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return self._pending
+
+    def flush(self, key=None):
+        """Flush one key's buffer (or every buffer) NOW."""
+        if not self._pending:
+            # unlocked fast path: get()-heavy loops flush per call and
+            # must not pay a lock round trip when nothing is buffered.
+            # Sound per the flush contract: a thread flushing its OWN
+            # earlier appends always sees its own _pending increment;
+            # a racing OTHER thread's append is covered by that
+            # thread's own flush triggers (and the window sweep).
+            return
+        with self._lock:
+            if key is None:
+                todo = list(self._buf.items())
+                self._buf.clear()
+            else:
+                buf = self._buf.pop(key, None)
+                todo = [(key, buf)] if buf else []
+            for k, entries in todo:
+                if not entries:
+                    continue
+                self._pending -= len(entries)
+                if self._observe:
+                    _observe_batch_size(len(entries))
+                try:
+                    self._flush_fn(k, entries)
+                except Exception:  # noqa: BLE001
+                    pass  # flush_fn owns its error handling; never wedge
+
+    def _flush_loop(self):
+        from ray_tpu.core import config as cfg
+
+        while not self._closed:
+            self._wake.wait(timeout=1.0)
+            self._wake.clear()
+            window = max(float(cfg.get(self._window_flag)), 0.1) / 1e3
+            time.sleep(window)
+            self.flush()
+
+    def close(self):
+        self._closed = True
+        self._wake.set()
+        self.flush()
+
+
 # ------------------------------------------------------ socket ownership
 
 
@@ -737,6 +885,7 @@ class RpcClient:
             for addr, entries in todo:
                 if not entries:
                     continue
+                _observe_batch_size(len(entries))
                 try:
                     peer = self._peer(addr)
                     if len(entries) == 1:
@@ -745,8 +894,10 @@ class RpcClient:
                     else:
                         peer.send([b"\x00" * 8, b"__batch__",
                                    ser.dumps_msg(entries)])
-                except PeerUnavailableError:
-                    pass  # best-effort
+                except (PeerUnavailableError, zmq.ZMQError):
+                    # best-effort; _peer() itself can raise ZMQError when
+                    # the context is tearing down under the flusher
+                    pass
 
     def flush_oneways(self):
         """Force-flush coalesced oneways NOW. Senders about to exit the

@@ -22,7 +22,8 @@ import cloudpickle
 from ray_tpu.core import exceptions as exc
 from ray_tpu.core import serialization as ser
 from ray_tpu.core.api import ObjectRef, _set_runtime
-from ray_tpu.core.cluster_runtime import ClusterRuntime
+from ray_tpu.core.cluster_runtime import ClusterRuntime, _submit_coalesced
+from ray_tpu.core.rpc import Batcher
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID
 from ray_tpu.core.object_store import open_store
 from ray_tpu.core.specs import INLINE_THRESHOLD, ActorSpec, RefArg, TaskSpec
@@ -77,10 +78,18 @@ class WorkerRuntime(ClusterRuntime):
         self.server.register("set_lease", self._h_set_lease)
         self.server.register("become_actor", self._h_become_actor, oneway=True)
         self.server.register("actor_call", self._h_actor_call)
+        self.server.register("actor_calls", self._h_actor_calls)
         self.server.register("dag_start", self._h_dag_start)
         self.server.register("dag_stop", self._h_dag_stop)
         self.server.register("exit_worker", self._h_exit, oneway=True)
         self._dag_loops: dict[str, threading.Event] = {}
+        # return-path coalescer: per-task task_done oneways to the same
+        # owner pack into one task_done_batch frame. Flush is
+        # idle-triggered (an exec thread whose inbox drained flushes
+        # NOW, so a lone sync task pays zero window latency) with the
+        # batcher's size cap and window as the burst/straggler bounds.
+        self._done_batcher = Batcher("task-done", self._flush_task_done,
+                                     observe_sizes=True)
 
     # ------------------------------------------------------------ args
 
@@ -126,9 +135,9 @@ class WorkerRuntime(ClusterRuntime):
                     ser.write_into(memoryview(buf), head_payload, views)
                     frames.append(bytes(buf))
                     locations.append(None)
-        self.client.send_oneway(owner, "task_done", {
+        self._done_batcher.append(owner, ({
             "task_id": task_id, "oids": oids, "locations": locations,
-        }, frames=frames)
+        }, frames))
 
     def _ship_error(self, owner: str, task_id: bytes, oids: list[bytes],
                     error: BaseException, retryable=False):
@@ -137,12 +146,30 @@ class WorkerRuntime(ClusterRuntime):
         except Exception:
             blob = ser.dumps_msg(exc.TaskError(RuntimeError(repr(error))))
         try:
-            self.client.send_oneway(owner, "task_done", {
+            self._done_batcher.append(owner, ({
                 "task_id": task_id, "oids": oids, "error": blob,
                 "retryable": retryable,
-            })
+            }, []))
         except Exception:
             pass
+
+    def _flush_task_done(self, owner: str, entries: list):
+        """Batcher flush hook: one frame per owner. A singleton stays a
+        plain task_done; N completions ride one task_done_batch with
+        their result frames concatenated in entry order."""
+        try:
+            if len(entries) == 1:
+                m, fr = entries[0]
+                self.client.send_oneway(owner, "task_done", m, frames=fr)
+                return
+            self.client.send_oneway(
+                owner, "task_done_batch",
+                {"entries": [m for m, _ in entries],
+                 "counts": [len(fr) for _, fr in entries]},
+                frames=[f for _, fr in entries for f in fr])
+            _submit_coalesced("task_done", len(entries))
+        except Exception:  # noqa: BLE001
+            pass  # oneways are best-effort by contract
 
     # ------------------------------------------------------------ streaming
 
@@ -211,6 +238,11 @@ class WorkerRuntime(ClusterRuntime):
                 if backpressure and produced - acked >= backpressure:
                     while not cancel.is_set():
                         try:
+                            # justified GL014: this is the backpressure
+                            # POLL loop — one round trip per poll IS the
+                            # protocol (consumer progress is the reply);
+                            # there is nothing to batch with
+                            # graftlint: disable=sequential-rpc-in-loop
                             r = self.client.call(owner, "stream_state",
                                                  {"task_id": task_id},
                                                  timeout=10)
@@ -324,6 +356,7 @@ class WorkerRuntime(ClusterRuntime):
 
     def _h_execute_task(self, msg, frames):
         self._exec_task_spec(TaskSpec(**msg["spec"]), notify_nodelet=True)
+        self._done_batcher.flush()  # classic path: one task per dispatch
 
     def _h_set_lease(self, msg, frames):
         """Nodelet-driven lease handoff. A keyed clear only applies if the
@@ -338,34 +371,48 @@ class WorkerRuntime(ClusterRuntime):
         return {}
 
     def _h_execute_leased(self, msg, frames):
-        """Enqueue-ack for a direct leased push. Dedup by (task_id,
-        attempt): the owner's submit sweeper may resend after a slow ack."""
+        """Enqueue-ack for a direct leased push — one frame carries a
+        BATCH of specs (the refill pipeline's coalesced form; a single
+        task is a batch of one). Dedup by (task_id, attempt): the
+        owner's submit sweeper may resend the whole frame after a slow
+        ack."""
         lid = msg.get("lease_id")
         if lid is not None and lid != self._current_lease:
             # stale push: the nodelet already re-credited this lease's
             # resources (TTL expiry / re-grant); running it would
             # oversubscribe the node (ADVICE r3). Owner resubmits classic.
             raise exc.StaleLeaseError("lease no longer held by this worker")
-        key = msg["spec"]["task_id"] + bytes([msg.get("attempt", 0) & 0xFF])
+        specs = msg["specs"]
+        attempts = msg.get("attempts") or [0] * len(specs)
+        queued = 0
         with self._seen_lock:
-            if key in self._seen_calls:
-                return {"queued": True, "duplicate": True}
-            self._seen_calls.add(key)
-            self._seen_calls_order.append(key)
+            fresh = []
+            for spec, attempt in zip(specs, attempts):
+                key = spec["task_id"] + bytes([attempt & 0xFF])
+                if key in self._seen_calls:
+                    continue
+                self._seen_calls.add(key)
+                self._seen_calls_order.append(key)
+                fresh.append(spec)
             if len(self._seen_calls_order) > 20000:
                 for old in self._seen_calls_order[:10000]:
                     self._seen_calls.discard(old)
                 del self._seen_calls_order[:10000]
-        self._task_inbox.put(msg)
-        return {"queued": True}
+        for spec in fresh:
+            self._task_inbox.put(spec)
+            queued += 1
+        return {"queued": queued, "duplicate": queued < len(specs)}
 
     def _task_exec_loop(self):
         while True:
-            msg = self._task_inbox.get()
-            if msg is None:
+            spec = self._task_inbox.get()
+            if spec is None:
                 return
-            self._exec_task_spec(TaskSpec(**msg["spec"]),
-                                 notify_nodelet=False)
+            self._exec_task_spec(TaskSpec(**spec), notify_nodelet=False)
+            if self._task_inbox.empty():
+                # inbox drained: ship buffered completions NOW (a lone
+                # sync task's owner is already blocked in get())
+                self._done_batcher.flush()
 
     def _exec_task_spec(self, spec: TaskSpec, notify_nodelet: bool):
         self._ctx.task_id = TaskID(spec.task_id)
@@ -486,6 +533,14 @@ class WorkerRuntime(ClusterRuntime):
         q.put(msg)
         return {"queued": True}
 
+    def _h_actor_calls(self, msg, frames):
+        """Batched actor_call frames from one owner's submit coalescer:
+        one dispatch enqueues N calls in submission order (the
+        per-actor ordering the coalescer preserves end to end)."""
+        for m in msg["calls"]:
+            self._h_actor_call(m, [])
+        return {"queued": len(msg["calls"])}
+
     def _ensure_async_loop(self):
         """Dedicated asyncio loop thread for `async def` actor methods
         (reference: async actors run on an event loop and complete OUT OF
@@ -578,6 +633,11 @@ class WorkerRuntime(ClusterRuntime):
                 self._ship_error(owner, task_id, oids, err)
                 self._report_task_event(task_id, label, "FAILED", t_start,
                                         "ACTOR_TASK")
+            finally:
+                if inbox.empty():
+                    # group inbox drained: callers are (about to be)
+                    # blocked on these results — flush buffered dones
+                    self._done_batcher.flush()
 
     def _make_async_done(self, owner, task_id, oids, label, t_start):
         def done(fut):
@@ -593,6 +653,11 @@ class WorkerRuntime(ClusterRuntime):
                 self._ship_error(owner, task_id, oids, err)
                 self._report_task_event(task_id, label, "FAILED", t_start,
                                         "ACTOR_TASK")
+            finally:
+                # async completions land outside any exec-loop idle
+                # check: flush unconditionally (out-of-order callers
+                # may already be blocked on exactly this result)
+                self._done_batcher.flush()
 
         return done
 
@@ -632,10 +697,17 @@ class WorkerRuntime(ClusterRuntime):
                     args = [first] + [c.get(timeout=60) for c in ins[1:]]
                 except Exception:  # noqa: BLE001
                     return
+                # an upstream stage's error marker passes through
+                # UNCHANGED (it consumes one slot per stage, so sequence
+                # numbers stay aligned and the driver re-raises the
+                # ORIGINAL error — same propagation as an eager chain)
+                marker = next((a for a in args
+                               if isinstance(a, dict)
+                               and "__dag_error__" in a), None)
                 try:
-                    for a in args:
-                        if isinstance(a, dict) and "__dag_error__" in a:
-                            raise RuntimeError(a["__dag_error__"])
+                    if marker is not None:
+                        out.put(marker)
+                        continue
                     if getattr(self, "_serial_actor", False):
                         with self._instance_lock:
                             result = fn(*args)
@@ -643,10 +715,16 @@ class WorkerRuntime(ClusterRuntime):
                         result = fn(*args)
                     out.put(result)
                 except Exception as e:  # noqa: BLE001
+                    # ship the same TaskError the eager path would raise
+                    # at get(); fall back to a repr if it won't pickle
+                    err = exc.TaskError.from_exception(e, f"dag:{method}")
                     try:
-                        out.put({"__dag_error__": f"{method}: {e!r}"})
+                        out.put({"__dag_error__": err})
                     except Exception:  # noqa: BLE001
-                        return
+                        try:
+                            out.put({"__dag_error__": f"{method}: {e!r}"})
+                        except Exception:  # noqa: BLE001
+                            return
 
         threading.Thread(target=run, daemon=True,
                          name=f"dag-loop-{method}").start()
@@ -659,6 +737,11 @@ class WorkerRuntime(ClusterRuntime):
         return {"ok": True}
 
     def _h_exit(self, msg, frames):
+        try:
+            self._done_batcher.flush()  # don't strand buffered results
+            self.client.flush_oneways()
+        except Exception:  # noqa: BLE001
+            pass
         os._exit(0)
 
 

@@ -9,13 +9,35 @@ of per-call task submission). Here the channels are the native shm SPSC
 rings (ray_tpu.experimental.channel) and the per-actor loops are
 installed by the worker runtime (dag_start).
 
-Usage:
+Compile once, execute many:
+
     with InputNode() as inp:
         x = a.step.bind(inp)
         y = b.step.bind(x)
-    dag = y.experimental_compile()
+    dag = y.compile()              # experimental_compile() also works
     out = dag.execute(5).get()
     dag.teardown()
+
+The fast-path contract (test-gated in tests/test_compiled_dag.py):
+
+- compile() resolves every actor address ONCE and pre-allocates one
+  reusable channel slot (an object-ID-named shm ring) per graph edge;
+  steady-state execute() is one channel write, intermediate results
+  flow worker→worker through their edge channels, and NO head, nodelet
+  or per-call RPC is involved.
+- Backpressure is structural: every channel is a bounded ring (a
+  producer blocks when its consumer's slots are full) and the driver
+  additionally caps in-flight executions at `max_inflight`, so a fast
+  producer can never overrun a slow consumer — memory stays bounded
+  end to end.
+- Errors propagate exactly like the eager `.remote()` chain: a stage's
+  exception rides the pipeline as a slot-consuming marker and `get()`
+  re-raises the same TaskError the eager path would raise.
+- On actor death the DAG falls back to the EAGER path: pending and
+  subsequent executions replay through ordinary actor calls (the heal
+  plane republishes routing for restartable actors; non-restartable
+  actors surface ActorDiedError), and teardown() releases every
+  channel slot either way.
 """
 
 from __future__ import annotations
@@ -27,12 +49,45 @@ from typing import Any
 
 _CHANNEL_CAP = 1 << 20
 
+# dag_executions_total (lazy: keep import-time free of the metrics
+# registry; the counter appears on first execute)
+_exec_counter = None
+_exec_counter_lock = threading.Lock()
+
+
+def _count_execution(fallback: bool):
+    global _exec_counter
+    if _exec_counter is None:
+        with _exec_counter_lock:
+            if _exec_counter is None:
+                try:
+                    from ray_tpu.util.metrics import Counter
+
+                    _exec_counter = Counter(
+                        "dag_executions_total",
+                        "compiled-DAG executions, by path "
+                        "(compiled|eager_fallback)",
+                        tag_keys=("path",))
+                except Exception:  # noqa: BLE001
+                    return
+    try:
+        _exec_counter.inc(
+            1, {"path": "eager_fallback" if fallback else "compiled"})
+    except Exception:  # noqa: BLE001
+        pass
+
 
 class _DagError:
-    """Slot-consuming error marker in the result sequence."""
+    """Slot-consuming error marker in the result sequence. Carries the
+    actual remote exception when it pickled, else a message string."""
 
-    def __init__(self, message: str):
-        self.message = message
+    def __init__(self, err):
+        self.err = err
+
+    def raise_(self):
+        if isinstance(self.err, BaseException):
+            raise self.err
+        raise RuntimeError(str(self.err))
 
 
 class DAGNode:
@@ -41,8 +96,13 @@ class DAGNode:
     def __init__(self, upstream: list["DAGNode"]):
         self.upstream = upstream
 
-    def experimental_compile(self) -> "CompiledDAG":
-        return CompiledDAG(self)
+    def compile(self, **kwargs) -> "CompiledDAG":
+        """Compile this graph into a resident channel pipeline (see the
+        module docstring for the fast-path contract)."""
+        return CompiledDAG(self, **kwargs)
+
+    def experimental_compile(self, **kwargs) -> "CompiledDAG":
+        return self.compile(**kwargs)
 
     def _walk(self, seen, order):
         if id(self) in seen:
@@ -101,8 +161,11 @@ class CompiledDAGRef:
 
 
 class CompiledDAG:
-    def __init__(self, output_node: DAGNode):
+    def __init__(self, output_node: DAGNode, max_inflight: int = 1024,
+                 channel_capacity: int = _CHANNEL_CAP,
+                 enable_fallback: bool = True):
         from ray_tpu.core.api import _global_runtime
+        from ray_tpu.core.ids import ObjectID
         from ray_tpu.experimental.channel import Channel
 
         self._rt = _global_runtime()
@@ -114,22 +177,30 @@ class CompiledDAG:
             raise ValueError("a compiled DAG needs exactly one InputNode")
         self._multi = isinstance(output_node, MultiOutputNode)
         self._loop_prefix = f"dag_{os.urandom(4).hex()}"
-        # one channel per EDGE (SPSC): producer node -> consumer slot
+        self._max_inflight = max(1, int(max_inflight))
+        self._enable_fallback = enable_fallback
+        self._broken = False  # actor died: every path goes eager
+        # one channel per EDGE — a reusable SLOT named by a pre-allocated
+        # object id, so the steady state re-uses N rings instead of
+        # minting per-call object ids (reference: shared-memory mutable
+        # objects, experimental/channel/shared_memory_channel.py)
         self._channels: list[Channel] = []
-        edge_chan: dict[tuple[int, int], Channel] = {}
 
         def make_chan():
-            c = Channel(capacity=_CHANNEL_CAP, create=True)
+            c = Channel(name=f"dagc_{ObjectID.random().hex()[:20]}",
+                        capacity=channel_capacity, create=True)
             self._channels.append(c)
             return c
 
         compute_nodes = [n for n in order
                          if isinstance(n, ClassMethodNode)]
+        self._compute_nodes = compute_nodes
         terminals = (output_node.upstream if self._multi
                      else [output_node])
         for t in terminals:
             if not isinstance(t, ClassMethodNode):
                 raise ValueError("DAG outputs must be bound actor methods")
+        self._terminals = terminals
         # input edges the driver writes directly
         self._input_edges: list[Channel] = []
         # per-node in/out channel wiring
@@ -147,12 +218,10 @@ class CompiledDAG:
         # terminal outputs flow to the driver through one channel each;
         # a node feeding BOTH another node and the driver fans out below
         self._output_chans: list[Channel] = []
-        term_ids = []
         for t in terminals:
             c = make_chan()
             node_out.setdefault(id(t), []).append(c)
             self._output_chans.append(c)
-            term_ids.append(id(t))
         # install per-actor loops. Fan-out (one producer, many consumer
         # channels) rides a driver-side pump when needed; the common
         # chain/tree case is pure actor-to-actor.
@@ -186,11 +255,21 @@ class CompiledDAG:
         self._seq = 0
         self._fetched = 0  # results drained from the output channels
         self._results: dict[int, Any] = {}
+        # inputs of not-yet-fetched executions, retained so an actor
+        # death can REPLAY them through the eager path (bounded by
+        # max_inflight; popped as their row is assembled)
+        self._pending_inputs: dict[int, Any] = {}
         # values already drained from SOME output channels of the row
         # currently being assembled — survives a get() timeout so a
         # partially-drained multi-output row is resumed, never lost
         self._partial: list = []
         self._fetch_lock = threading.Lock()
+        # driver-side backpressure: execute() blocks here once
+        # max_inflight executions are unfetched
+        self._flow = threading.Condition()
+        # channel writes leave in seq order (concurrent execute())
+        self._write_cond = threading.Condition()
+        self._next_write = 0
 
     def _start_pump(self, src, dsts):
         def pump():
@@ -215,37 +294,191 @@ class CompiledDAG:
 
     def execute(self, value: Any) -> CompiledDAGRef:
         """One pipelined execution: a channel write; results stream back
-        in order (reference: CompiledDAG.execute)."""
-        self._write_chan.put(value, timeout=60)
-        ref = CompiledDAGRef(self, self._seq)
-        self._seq += 1
+        in order (reference: CompiledDAG.execute). Blocks once
+        max_inflight executions are in the pipe (backpressure: a fast
+        submitter cannot overrun the slowest stage's channel slots)."""
+        t0 = time.monotonic_ns()
+        with self._flow:
+            # the cap applies on the eager-fallback path too: retained
+            # inputs are the fallback's replay state and must stay as
+            # bounded as the channel-resident work they replace
+            while self._seq - self._fetched >= self._max_inflight:
+                if not self._flow.wait(timeout=60.0) and \
+                        self._seq - self._fetched >= self._max_inflight:
+                    raise TimeoutError(
+                        "compiled DAG backpressured for 60s: "
+                        "max_inflight results unfetched")
+            seq = self._seq
+            self._seq += 1
+            self._pending_inputs[seq] = value
+        # channel writes are serialized IN SEQ ORDER: two concurrent
+        # execute() calls must not land their inputs swapped, or the
+        # in-order result rows would resolve against the wrong refs
+        with self._write_cond:
+            while self._next_write != seq and not self._broken:
+                self._write_cond.wait(timeout=1.0)
+            if not self._broken:
+                try:
+                    self._write_chan.put(value, timeout=60)
+                except Exception:  # noqa: BLE001
+                    # pipeline wedged (channel closed / full forever):
+                    # flip to the eager path — the value is retained,
+                    # the row gets filled at fetch time
+                    self._broken = True
+            self._next_write = max(self._next_write, seq + 1)
+            self._write_cond.notify_all()
+        ref = CompiledDAGRef(self, seq)
+        _count_execution(fallback=self._broken)
+        self._rt._events.record(f"dag.execute:{seq}", "dag", t0)
         return ref
 
     def _fetch(self, seq: int, timeout):
         """Results arrive strictly in execution order (SPSC channels):
         drain until `seq` has landed. Errors CONSUME their slot like any
         result — raising without recording would desynchronize every
-        later execution's sequence number."""
+        later execution's sequence number. A drain that stalls past its
+        poll slice probes the DAG's actors; a dead actor flips the DAG
+        to the eager path and pending executions replay there."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
         with self._fetch_lock:
             while seq not in self._results:
-                # drain channel-by-channel into the resumable partial row:
-                # a timeout mid-row must not discard already-popped values
-                # (SPSC pops are destructive)
-                while len(self._partial) < len(self._output_chans):
-                    c = self._output_chans[len(self._partial)]
-                    self._partial.append(c.get(timeout=timeout))
-                outs, self._partial = self._partial, []
-                err = next((o["__dag_error__"] for o in outs
-                            if isinstance(o, dict) and "__dag_error__" in o),
-                           None)
-                self._results[self._fetched] = (
-                    _DagError(err) if err is not None
-                    else (outs if self._multi else outs[0]))
-                self._fetched += 1
+                if self._broken:
+                    self._fallback_fill()
+                    continue
+                try:
+                    self._drain_row(deadline)
+                except _PipelineStalled:
+                    self._broken = True  # probe said an actor is dead
+                    continue
             out = self._results.pop(seq)
+            self._pending_inputs.pop(seq, None)
             if isinstance(out, _DagError):
-                raise RuntimeError(out.message)
+                out.raise_()
             return out
+
+    def _drain_row(self, deadline):
+        """Assemble the next result row from the output channels (called
+        under _fetch_lock). Channel pops are destructive, so partially
+        drained rows persist in self._partial across timeouts."""
+        from ray_tpu.experimental.channel import ChannelClosed
+
+        stalls = 0
+        next_probe = 1  # probe backoff: 1, 2, 4, ... slices (cap 16)
+        while len(self._partial) < len(self._output_chans):
+            c = self._output_chans[len(self._partial)]
+            rem = (None if deadline is None
+                   else deadline - time.monotonic())
+            if rem is not None and rem <= 0:
+                raise TimeoutError("compiled DAG result timed out")
+            try:
+                # short poll slices so a dead mid-chain actor is
+                # detected in ~1s instead of blocking the full window
+                self._partial.append(
+                    c.get(timeout=min(1.0, rem) if rem is not None
+                          else 1.0))
+                stalls = 0
+                next_probe = 1
+            except TimeoutError:
+                # probe with exponential backoff: a legitimately SLOW
+                # stage (30s inference step) must not turn every
+                # blocked get into 1 head RPC per second
+                stalls += 1
+                if self._enable_fallback and stalls >= next_probe:
+                    if self._any_actor_dead():
+                        raise _PipelineStalled from None
+                    next_probe = min(next_probe * 2, 16)
+                    stalls = 0
+                continue
+            except ChannelClosed:
+                if self._enable_fallback:
+                    raise _PipelineStalled from None
+                raise
+        outs, self._partial = self._partial, []
+        err = next((o["__dag_error__"] for o in outs
+                    if isinstance(o, dict) and "__dag_error__" in o),
+                   None)
+        row = self._fetched
+        self._results[row] = (
+            _DagError(err) if err is not None
+            else (outs if self._multi else outs[0]))
+        self._pending_inputs.pop(row, None)
+        self._fetched += 1
+        with self._flow:
+            self._flow.notify_all()
+
+    # ------------------------------------------------------ eager fallback
+
+    def _any_actor_dead(self) -> bool:
+        """Pipeline-liveness probe (only runs when a drain stalls —
+        never on the steady-state path). An actor that is DEAD is lost;
+        so is one that restarted to a NEW address: the replacement
+        process has no dag loop, so the compiled pipeline can never
+        make progress even though the actor is ALIVE — both flip the
+        DAG to the eager path."""
+        replies = self._rt.client.call_gather(
+            [(self._rt.head_address, "get_actor",
+              {"actor_id": n.actor_handle._actor_id.binary(),
+               "wait": False}) for n in self._compute_nodes],
+            timeout=5)
+        for r, (compiled_addr, _) in zip(replies, self._loop_ids):
+            if r is None:
+                return True  # head unreachable: treat as lost
+            state = r.get("state")
+            if state in ("DEAD", "UNKNOWN"):
+                return True
+            if state == "ALIVE" and r.get("address") != compiled_addr:
+                return True  # restarted: loop gone with the process
+        return False
+
+    def _fallback_fill(self):
+        """Replay every unfetched execution through the EAGER actor-call
+        path, in order (called under _fetch_lock once _broken). The
+        partially drained compiled row is discarded — the replay
+        recomputes it whole; routing re-resolves through the heal
+        plane, so restartable actors serve the replay and dead ones
+        surface ActorDiedError exactly like an eager chain would."""
+        self._partial = []
+        # SNAPSHOT the sequence watermark under _flow: execute() racing
+        # this fill advances _seq concurrently, and advancing _fetched
+        # past a seq whose row was never filled would hang its fetch
+        # forever (the raced execution is covered by the next fill —
+        # _fetch re-enters here while its seq has no result)
+        with self._flow:
+            seq_snap = self._seq
+        for s in range(self._fetched, seq_snap):
+            if s in self._results:
+                continue
+            try:
+                row = self._eager_once(self._pending_inputs.get(s))
+            except BaseException as e:  # noqa: BLE001
+                # strip the traceback: its frames hold _eager_once's
+                # intermediate ObjectRefs, and an exception retained in
+                # _results would pin their refcounts — stranding every
+                # oid of the failed replay (TaskError already carries
+                # the remote traceback as a string)
+                e.__traceback__ = None
+                row = _DagError(e)
+            self._results[s] = row
+            _count_execution(fallback=True)
+        self._fetched = max(self._fetched, seq_snap)
+        with self._flow:
+            self._flow.notify_all()
+
+    def _eager_once(self, value):
+        """One execution through ordinary `.remote()` calls — the
+        bit-parity reference for the compiled path (and its fallback)."""
+        refs: dict[int, Any] = {}
+        for n in self._order:
+            if isinstance(n, InputNode):
+                refs[id(n)] = value
+            elif isinstance(n, ClassMethodNode):
+                args = [refs[id(u)] for u in n.upstream]
+                refs[id(n)] = getattr(
+                    n.actor_handle, n.method_name).remote(*args)
+        outs = self._rt.get([refs[id(t)] for t in self._terminals],
+                            timeout=60)
+        return outs if self._multi else outs[0]
 
     def teardown(self):
         self._stop.set()
@@ -274,6 +507,12 @@ class CompiledDAG:
                 c.destroy()
             except Exception:  # noqa: BLE001
                 pass
+        self._pending_inputs.clear()
+
+
+class _PipelineStalled(Exception):
+    """Internal: the compiled pipeline cannot make progress (dead actor
+    or closed channel); the fetch loop flips to the eager path."""
 
 
 __all__ = ["ClassMethodNode", "CompiledDAG", "CompiledDAGRef", "DAGNode",

@@ -16,6 +16,7 @@ from ray_tpu.devtools.rules import (  # noqa: F401
     lock_order,
     oneway_raise,
     oneway_return,
+    sequential_rpc,
     spmd_nondeterminism,
     store_refcount,
 )

@@ -1,0 +1,135 @@
+"""GL014: a per-item blocking RPC round trip inside a hot loop that
+should ride a batch API.
+
+The motivating shape came out of the ISSUE-11 fast-path review: the
+submit path paid one SYNCHRONOUS ``schedule_task`` call per task inside
+the submit loop — N round trips, N thread-pool dispatches, N socket
+writes — when the transport offers batch frames (``schedule_tasks`` /
+``actor_calls`` / ``execute_leased`` with a spec list), the submit-side
+``Batcher``, and ``call_gather`` (one shared deadline across a fan-out).
+A loop like::
+
+    for oid in oids:
+        self.client.call(holder, "free_object", {"oid": oid})
+
+serializes N network round trips where one batched frame (or one
+``call_gather``) pays a single wait. ``send_oneway`` in a loop is NOT
+flagged: the oneway batcher already coalesces those per peer.
+
+Heuristic: inside a ``for`` loop body (own scope — nested function
+bodies belong to their own scope, like GL011), flag a blocking
+``.call(...)`` / ``.call_frames(...)`` on a client receiver (path
+mentions ``client``, or ``RpcClient.shared()``) whose ADDRESS argument
+is loop-invariant — it references no name bound by the loop (loop
+targets or names assigned anywhere in the body). Loop-variant addresses
+(one peer per item) are a fan-out, where ``call_gather`` may still be
+better but each call is necessary; ``range(...)`` loops stay quiet —
+they are retry/backoff loops, where sequential calls are the point.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools.context import ModuleContext, qualname
+from ray_tpu.devtools.registry import Rule, register
+
+_RPC_METHODS = {"call", "call_frames"}
+
+
+def _is_range_loop(node: ast.For) -> bool:
+    it = node.iter
+    return (isinstance(it, ast.Call)
+            and isinstance(it.func, ast.Name)
+            and it.func.id == "range")
+
+
+def _bound_names(node: ast.For) -> set[str]:
+    """Names the loop binds: its targets plus anything stored in the
+    body (so an address derived per item — ``loc = ...`` then
+    ``client.call(loc, ...)`` — counts as loop-variant)."""
+    out: set[str] = set()
+    for t in ast.walk(node.target):
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+    for child in node.body:
+        for sub in ast.walk(child):
+            if isinstance(sub, ast.Name) and \
+                    isinstance(sub.ctx, ast.Store):
+                out.add(sub.id)
+    return out
+
+
+def _client_recv(call: ast.Call) -> str | None:
+    f = call.func
+    if not isinstance(f, ast.Attribute) or f.attr not in _RPC_METHODS:
+        return None
+    recv = qualname(f.value)
+    if recv is not None and "client" in recv.lower():
+        return recv
+    if isinstance(f.value, ast.Call):
+        inner = qualname(f.value.func)
+        if inner is not None and inner.endswith("RpcClient.shared"):
+            return "RpcClient.shared()"
+    return None
+
+
+@register
+class SequentialRpcInLoopRule(Rule):
+    name = "sequential-rpc-in-loop"
+    code = "GL014"
+    description = ("per-item blocking RPC round trip in a for loop with "
+                   "a loop-invariant peer — should ride a batch frame "
+                   "or call_gather")
+    invariant = ("hot loops never serialize N network round trips the "
+                 "transport can coalesce into one frame / one shared "
+                 "deadline")
+    interests = ("For",)
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        # id(call) -> [call, union of enclosing loops' bound names]
+        self._events: dict[int, list] = {}
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if not isinstance(node, ast.For) or _is_range_loop(node):
+            return
+        bound = _bound_names(node)
+        for child in node.body + node.orelse:
+            for sub in self._walk_same_scope(child):
+                if not isinstance(sub, ast.Call):
+                    continue
+                if _client_recv(sub) is None:
+                    continue
+                ent = self._events.setdefault(id(sub), [sub, set()])
+                ent[1] |= bound
+
+    @staticmethod
+    def _walk_same_scope(node: ast.AST):
+        """ast.walk, but never descend into nested function/class
+        bodies — a call there belongs to that scope (GL011's rule)."""
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            yield n
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda,
+                                      ast.ClassDef)):
+                    continue
+                stack.append(child)
+
+    def end_module(self, ctx: ModuleContext) -> None:
+        for call, bound in self._events.values():
+            if not call.args:
+                continue
+            addr_names = {n.id for n in ast.walk(call.args[0])
+                          if isinstance(n, ast.Name)}
+            if addr_names & bound:
+                continue  # loop-variant peer: a genuine fan-out
+            method = call.func.attr
+            ctx.report(self, call,
+                       f"blocking .{method}() to a loop-invariant peer "
+                       "inside a for loop — N round trips the transport "
+                       "can coalesce; use a batch frame (schedule_tasks/"
+                       "actor_calls-style), the submit Batcher, or "
+                       "call_gather (one shared deadline)")

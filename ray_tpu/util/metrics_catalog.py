@@ -158,6 +158,16 @@ CATALOG: list[dict] = [
     {"name": "rl_weight_swap_seconds", "type": "histogram",
      "where": "ray_tpu/serve/llm/engine.py",
      "what": "drain-free weight hot-swap wall time"},
+    # core fast path (coalesced submission + compiled DAGs)
+    {"name": "rpc_oneway_batch_size", "type": "histogram",
+     "where": "ray_tpu/core/rpc.py",
+     "what": "messages coalesced per flushed batch frame"},
+    {"name": "core_submit_coalesced_total", "type": "counter",
+     "where": "ray_tpu/core/cluster_runtime.py",
+     "what": "submissions/returns that rode a coalesced frame, by kind"},
+    {"name": "dag_executions_total", "type": "counter",
+     "where": "ray_tpu/dag/__init__.py",
+     "what": "compiled-DAG executions, by path (compiled|eager_fallback)"},
     # span plane
     {"name": "spans_sampled_total", "type": "counter",
      "where": "ray_tpu/utils/events.py",

@@ -35,12 +35,16 @@ def epoch_us(monotonic_ns: int | None = None) -> float:
 
 def child_trace(parent: dict | None) -> dict:
     """New span context under `parent` (OTel-style propagation —
-    reference: tracing_helper.py:34). A None parent starts a trace."""
-    import os
+    reference: tracing_helper.py:34). A None parent starts a trace.
+    Ids come from the runtime's fast per-thread PRNG: this runs on
+    EVERY task submit, and os.urandom is a ~100us syscall on small
+    virtualized guests (measured in the ISSUE-11 profile)."""
+    from ray_tpu.core.ids import _id_rng
 
-    span_id = os.urandom(8).hex()
+    rng = _id_rng.rng
+    span_id = rng.randbytes(8).hex()
     if parent is None:
-        return {"trace_id": os.urandom(16).hex(), "span_id": span_id,
+        return {"trace_id": rng.randbytes(16).hex(), "span_id": span_id,
                 "parent_id": None}
     return {"trace_id": parent["trace_id"], "span_id": span_id,
             "parent_id": parent["span_id"]}

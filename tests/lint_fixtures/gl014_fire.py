@@ -1,0 +1,22 @@
+"""GL014 fire fixture: per-item blocking RPCs to a loop-invariant peer."""
+from ray_tpu.core.rpc import RpcClient
+
+
+class Freer:
+    def __init__(self, client, nodelet):
+        self.client = client
+        self.nodelet = nodelet
+
+    def free_all(self, oids):
+        for oid in oids:  # same peer every iteration: one frame would do
+            self.client.call(self.nodelet, "free_object", {"oid": oid})
+
+    def probe_all(self, task_ids, head):
+        for tid in task_ids:
+            RpcClient.shared().call_frames(head, "task_state",
+                                           {"task_id": tid})
+
+    def nested_collection_loop(self, groups):
+        for group in groups:
+            for item in group:  # peer fixed across both loops
+                self.client.call(self.nodelet, "touch", {"item": item})

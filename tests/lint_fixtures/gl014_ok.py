@@ -1,0 +1,34 @@
+"""GL014 ok fixture: fan-outs, gathers, retries, oneways stay quiet."""
+
+
+class Clean:
+    def __init__(self, client, nodelet):
+        self.client = client
+        self.nodelet = nodelet
+
+    def gather(self, oids):
+        # sanctioned: one shared deadline across the fan-out
+        return self.client.call_gather(
+            [(self.nodelet, "free_object", {"oid": o}) for o in oids])
+
+    def per_peer(self, leases):
+        for le in leases:  # loop-variant peer: a genuine fan-out
+            self.client.call(le.nodelet, "return_lease",
+                             {"lease_id": le.lease_id})
+
+    def derived_peer(self, args):
+        for a in args:
+            loc = a.location or self.nodelet  # bound in the loop body
+            self.client.call(loc, "object_meta", {"oid": a.oid})
+
+    def retry(self, addr, msg):
+        for attempt in range(3):  # range loop: sequential is the point
+            try:
+                return self.client.call(addr, "actor_call", msg)
+            except Exception:  # noqa: BLE001
+                continue
+
+    def oneways(self, oids):
+        for oid in oids:  # oneway batcher already coalesces these
+            self.client.send_oneway(self.nodelet, "free_object",
+                                    {"oid": oid})

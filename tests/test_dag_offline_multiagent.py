@@ -51,8 +51,12 @@ def test_compiled_dag_chain_and_errors(ray_boot):
         # pipelined executions come back in order
         refs = [dag.execute(i) for i in range(50)]
         assert [r.get() for r in refs] == [i + 11 for i in range(50)]
-        # errors propagate through the pipeline to the caller
-        with pytest.raises(RuntimeError, match="boom"):
+        # errors propagate through the pipeline to the caller — the
+        # SAME TaskError the eager .remote() chain raises (bit-parity
+        # gated in tests/test_compiled_dag.py)
+        from ray_tpu.core.exceptions import TaskError
+
+        with pytest.raises(TaskError, match="boom"):
             dag.execute("boom").get()
     finally:
         dag.teardown()

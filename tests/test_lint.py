@@ -42,6 +42,7 @@ RULE_CASES = [
     ("GL011", "oneway-exception", "gl011_fire.py", "gl011_ok.py", 4),
     ("GL012", "blocking-under-lock", "gl012_fire.py", "gl012_ok.py", 3),
     ("GL013", "handler-reentry", "gl013_fire.py", "gl013_ok.py", 3),
+    ("GL014", "sequential-rpc-in-loop", "gl014_fire.py", "gl014_ok.py", 3),
 ]
 
 
@@ -63,7 +64,7 @@ def test_rule_catalog_complete():
     catalog = rule_catalog()
     assert [c.code for c in catalog] == [
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-        "GL008", "GL009", "GL010", "GL011", "GL012", "GL013"]
+        "GL008", "GL009", "GL010", "GL011", "GL012", "GL013", "GL014"]
     for cls in catalog:
         assert cls.name and cls.description and cls.invariant
 

@@ -1,10 +1,19 @@
-"""Oneway small-message coalescing (reference role: gRPC stream
-batching for high-frequency control messages — VERDICT r4 weak item 3:
-the transport must aggregate small messages under concurrency)."""
+"""Submit/return-path coalescing (ISSUE 11) + oneway small-message
+coalescing (reference role: gRPC stream batching for high-frequency
+control messages — VERDICT r4 weak item 3: the transport must
+aggregate small messages under concurrency).
 
+The submit coalescer packs pending task/actor-call submissions to the
+same peer into one batched RPC frame (actor_calls / schedule_tasks /
+multi-spec execute_leased) and the return path batches workers'
+per-task task_done oneways symmetrically (task_done_batch)."""
+
+import threading
 import time
 
-from ray_tpu.core.rpc import RpcClient, RpcServer
+import pytest
+
+from ray_tpu.core.rpc import Batcher, RpcClient, RpcServer
 
 
 def test_oneway_batching_delivers_all_with_fewer_sends():
@@ -78,6 +87,197 @@ def test_large_or_framed_oneways_bypass_batching():
         while time.time() < deadline and len(got) < 2:
             time.sleep(0.01)
         assert sorted(got) == [(0, 1), (64 * 1024, 0)]
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_batcher_size_triggered_inline_flush(monkeypatch):
+    """A buffer reaching SUBMIT_BATCH_MAX flushes on the appending
+    thread — a tight submit loop never waits for the window."""
+    monkeypatch.setenv("RAY_TPU_SUBMIT_BATCH_MAX", "8")
+    flushed = []
+    b = Batcher("t", lambda key, entries: flushed.append(
+        (key, list(entries))))
+    for i in range(8):
+        b.append("k", i)
+    assert flushed == [("k", list(range(8)))]
+    assert b.pending_count() == 0
+    b.close()
+
+
+def test_batcher_window_flushes_stragglers(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_SUBMIT_BATCH_MAX", "1000")
+    flushed = []
+    b = Batcher("t", lambda key, entries: flushed.append(list(entries)))
+    b.append("k", 1)
+    b.append("k", 2)
+    deadline = time.time() + 5
+    while time.time() < deadline and not flushed:
+        time.sleep(0.005)
+    assert flushed == [[1, 2]]  # idle window swept the partial batch
+    b.close()
+
+
+def test_batcher_force_flush_and_per_key_order(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_SUBMIT_BATCH_MAX", "1000")
+    flushed = []
+    b = Batcher("t", lambda key, entries: flushed.append(
+        (key, list(entries))))
+    for i in range(5):
+        b.append("a", i)
+    b.append("b", 99)
+    b.flush("a")  # only a's buffer leaves
+    assert flushed == [("a", [0, 1, 2, 3, 4])]
+    b.flush()
+    assert flushed[1] == ("b", [99])
+    b.close()
+
+
+def test_batcher_window_zero_sends_immediately(monkeypatch):
+    """SUBMIT_BATCH_WINDOW_MS=0 = send each immediately (the config
+    flag's documented contract, same as the oneway batcher's)."""
+    monkeypatch.setenv("RAY_TPU_SUBMIT_BATCH_WINDOW_MS", "0")
+    flushed = []
+    b = Batcher("t", lambda key, entries: flushed.append(list(entries)))
+    b.append("k", 1)
+    b.append("k", 2)
+    assert flushed == [[1], [2]]  # no buffering, no sweeper involved
+    b.close()
+
+
+def test_batcher_flush_fn_error_never_wedges():
+    calls = []
+
+    def boom(key, entries):
+        calls.append(list(entries))
+        raise RuntimeError("flush boom")
+
+    b = Batcher("t", boom)
+    b.append("k", 1)
+    b.flush()
+    b.append("k", 2)
+    b.flush()
+    assert calls == [[1], [2]]  # second flush still ran
+    assert b.pending_count() == 0
+    b.close()
+
+
+# ------------------------------------------------- cluster-level batching
+
+
+@pytest.fixture(scope="module")
+def batch_cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_actor_call_burst_coalesces_and_stays_ordered(batch_cluster):
+    """A burst of pipelined actor calls rides actor_calls frames (the
+    driver's coalesce counter moves) while per-actor submission order
+    is preserved on a serial actor, and the return path delivers every
+    result (task_done_batch dispatches on the driver's own server)."""
+    import ray_tpu
+    from ray_tpu.core.api import _global_runtime
+    from ray_tpu.util.metrics import prometheus_text
+
+    @ray_tpu.remote(num_cpus=0)
+    class Seq:
+        def __init__(self):
+            self.log = []
+
+        def mark(self, i):
+            self.log.append(i)
+            return i
+
+        def read(self):
+            return list(self.log)
+
+    # earlier suites may clear_registry(): reset the lazy counter so it
+    # re-registers into the current registry
+    from ray_tpu.core import cluster_runtime as cr
+
+    cr._coalesced_counter = None
+    rt = _global_runtime()
+    a = Seq.remote()
+    ray_tpu.get(a.read.remote())
+
+    def counts():
+        out = {}
+        for line in prometheus_text().splitlines():
+            if line.startswith("core_submit_coalesced_total"):
+                name, v = line.rsplit(" ", 1)
+                out[name] = float(v)
+        return out
+
+    before = counts()
+    n = 400
+    refs = [a.mark.remote(i) for i in range(n)]
+    assert ray_tpu.get(refs, timeout=120) == list(range(n))
+    # order preserved end to end through the batched frames
+    assert ray_tpu.get(a.read.remote(), timeout=60) == list(range(n))
+    after = counts()
+    key = 'core_submit_coalesced_total{kind="actor_call"}'
+    assert after.get(key, 0) - before.get(key, 0) > 0, (before, after)
+    # the return path coalesced too: the driver's server dispatched
+    # task_done_batch frames, far fewer than one per call
+    stats = rt.server.event_stats()
+    assert stats.get("task_done_batch", {}).get("count", 0) > 0
+    ray_tpu.kill(a)
+
+
+def test_plain_task_burst_rides_schedule_tasks_frames(batch_cluster):
+    """Tasks off the lease path (here: soft label selector) coalesce
+    into schedule_tasks frames on the nodelet — far fewer scheduling
+    dispatches than tasks — and every result lands."""
+    import ray_tpu
+    from ray_tpu.core.api import _global_runtime
+    from ray_tpu.util.scheduling_strategies import SOFT_AFFINITY_LABEL
+
+    rt = _global_runtime()
+    nodelet = rt._booted[1]
+
+    @ray_tpu.remote(num_cpus=0.1,
+                    label_selector={"no-such-label": "x",
+                                    SOFT_AFFINITY_LABEL: "1"})
+    def double(x):
+        return x * 2
+
+    assert ray_tpu.get(double.remote(1), timeout=60) == 2  # warm path
+    before = nodelet.server.event_stats()
+    n = 100
+    refs = [double.remote(i) for i in range(n)]
+    assert ray_tpu.get(refs, timeout=120) == [i * 2 for i in range(n)]
+    after = nodelet.server.event_stats()
+    batched = after.get("schedule_tasks", {}).get("count", 0) - \
+        before.get("schedule_tasks", {}).get("count", 0)
+    singles = after.get("schedule_task", {}).get("count", 0) - \
+        before.get("schedule_task", {}).get("count", 0)
+    assert batched >= 1
+    # the burst rode batch frames, not per-task round trips
+    assert batched + singles < n / 2, (batched, singles)
+
+
+def test_oneway_batch_size_histogram_observes():
+    from ray_tpu.util.metrics import prometheus_text
+
+    # earlier suites may clear_registry(): reset the lazy histogram so
+    # it re-registers into the current registry
+    import ray_tpu.core.rpc as rpc_mod
+
+    rpc_mod._batch_size_hist = None
+    server = RpcServer(name="hist-test").start()
+    server.register("tick", lambda msg, frames: None, oneway=True)
+    client = RpcClient()
+    try:
+        for i in range(50):
+            client.send_oneway(server.address, "tick", {"i": i})
+        client.flush_oneways()
+        text = prometheus_text()
+        assert "rpc_oneway_batch_size_count" in text
     finally:
         client.close()
         server.stop()
