@@ -95,7 +95,8 @@ def _ppo_bench_subprocess() -> dict:
 
 
 def _time_steps(step, state, batch, mesh, warmup: int, steps: int,
-                profile_dir: str | None = None):
+                profile_dir: str | None = None,
+                collapsed_path: str | None = None):
     """Warmup, then time `steps` compiled steps. Sync via a device-to-
     host copy of the loss — block_until_ready is not a reliable barrier
     on every PJRT plugin. `profile_dir` arms a device-profiler capture
@@ -118,7 +119,13 @@ def _time_steps(step, state, batch, mesh, warmup: int, steps: int,
         # attribution runs (--trace): the table covers the TIMED steps
         # only, so phase totals compare against `dt` directly
         spmd.waterfall.reset()
-        with _tracing.profiler_capture(profile_dir) as captured:
+        # --profile: host-side stack sampler over the SAME timed-steps
+        # window as the device capture (warmup/compile excluded — the
+        # collapsed output attributes steady-state host path only)
+        from ray_tpu.util.profiler import capture_to_file
+
+        with _tracing.profiler_capture(profile_dir) as captured, \
+                capture_to_file(collapsed_path):
             t0 = _time.perf_counter()
             for _ in range(steps):
                 state, metrics = step(state, batch)
@@ -127,7 +134,7 @@ def _time_steps(step, state, batch, mesh, warmup: int, steps: int,
     return state, final_loss, dt, captured
 
 
-def main(trace: str | None = None):
+def main(trace: str | None = None, profile: bool = False):
     import jax
     import jax.numpy as jnp
     import optax
@@ -194,10 +201,18 @@ def main(trace: str | None = None):
     # host-side waterfall cannot see. Path lands in the run metadata
     # below and on the chrome trace as the profiler.capture span.
     profile_dir = f"{trace}.profile" if (trace and on_tpu) else None
+    # --profile arms the host-side stack sampler around the TIMED steps
+    # only (inside _time_steps, next to the device capture — warmup and
+    # compile stay outside the window); unarmed runs construct nothing
+    collapsed_path = (f"{trace}.collapsed" if trace
+                      else "bench.collapsed") if profile else None
     with tracing.span("bench.gpt2", category="bench"):
         state, final_loss, dt, captured = _time_steps(
             step, state, batch, mesh, warmup, steps,
-            profile_dir=profile_dir)
+            profile_dir=profile_dir, collapsed_path=collapsed_path)
+    if collapsed_path:
+        print(f"# wrote collapsed stacks to {collapsed_path}",
+              flush=True)
     # per-phase attribution of the timed gpt2 steps (--trace runs):
     # phases sum to ~dt, so the percents decompose the MFU number
     attribution = spmd.waterfall.summary() if trace else None
@@ -340,4 +355,9 @@ if __name__ == "__main__":
     ap.add_argument("--trace", default=None,
                     help="also dump a chrome trace (spans incl. "
                          "compiles) to this file")
-    main(trace=ap.parse_args().trace)
+    ap.add_argument("--profile", action="store_true",
+                    help="arm the stack sampler around the timed steps "
+                         "and write flamegraph-compatible .collapsed "
+                         "stacks next to the --trace artifact")
+    _a = ap.parse_args()
+    main(trace=_a.trace, profile=_a.profile)

@@ -93,10 +93,22 @@ class Client:
         return n * arr.nbytes / (time.perf_counter() - t0) / 1e9
 
 
+# --profile: collapsed stacks accumulated across the timed shapes only
+# (never warmup, idle gates, or teardown). None = unarmed = free.
+_profile_stacks = None
+
+
+def _armed():
+    from ray_tpu.util import profiler
+
+    return profiler.accumulate(_profile_stacks)
+
+
 def _rate(fn, n):
-    t0 = time.perf_counter()
-    fn(n)
-    return n / (time.perf_counter() - t0)
+    with _armed():
+        t0 = time.perf_counter()
+        fn(n)
+        return n / (time.perf_counter() - t0)
 
 
 def _wait_for_idle(max_wait_s: float = 240.0, load_thresh: float = 0.7):
@@ -165,8 +177,9 @@ def _bench_compiled_dag(quick: bool) -> dict:
         # cap the wait; compiled and eager samples interleave the same
         # contention either way and the RATIO is the headline
         waited = _wait_for_idle(max_wait_s=60.0)
-        compiled = [one_sample("compiled") for _ in range(samples)]
-        eager = [one_sample("eager") for _ in range(3)]
+        with _armed():
+            compiled = [one_sample("compiled") for _ in range(samples)]
+            eager = [one_sample("eager") for _ in range(3)]
         med = statistics.median(compiled)
         sd = statistics.pstdev(compiled)
         agg = {
@@ -202,6 +215,11 @@ def main():
             print("error: --trace needs a filename", file=sys.stderr)
             sys.exit(2)
         trace = sys.argv[i + 1]
+    # --profile: arm the stack sampler around the timed shapes and
+    # write .collapsed next to the --trace artifact
+    if "--profile" in sys.argv:
+        global _profile_stacks
+        _profile_stacks = {}
 
     def N(n):
         return max(10, int(n * scale))
@@ -328,6 +346,12 @@ def main():
         # dump BEFORE shutdown: the merged timeline needs the runtime
         tracing.dump(trace)
         print(f"# wrote trace to {trace}")
+    if _profile_stacks is not None:
+        from ray_tpu.util import profiler
+
+        path = f"{trace}.collapsed" if trace else "bench_core.collapsed"
+        profiler.write_collapsed(path, _profile_stacks)
+        print(f"# wrote collapsed stacks to {path}")
     ray_tpu.shutdown()
 
 

@@ -188,7 +188,18 @@ def main():
     ap.add_argument("--skip-perf", action="store_true")
     ap.add_argument("--trace", default=None,
                     help="dump a chrome trace of the run to this file")
+    ap.add_argument("--profile", action="store_true",
+                    help="arm the stack sampler around the measured "
+                         "perf windows (rides bench_serve's _recipe "
+                         "hook) and write .collapsed next to --trace")
     args = ap.parse_args()
+
+    if args.profile:
+        # the perf scenario measures through bench_serve._recipe, so
+        # arming ITS hook samples exactly the measured windows
+        import bench_serve as _bs
+
+        _bs._profile_stacks = {}
 
     curve = bench_learning_curve(args)
     extra = {"learning": curve}
@@ -223,6 +234,14 @@ def main():
 
         tracing.dump(args.trace)
         print(f"# wrote trace to {args.trace}")
+    if args.profile:
+        import bench_serve as _bs
+        from ray_tpu.util import profiler
+
+        path = (f"{args.trace}.collapsed" if args.trace
+                else "bench_rl.collapsed")
+        profiler.write_collapsed(path, _bs._profile_stacks or {})
+        print(f"# wrote collapsed stacks to {path}")
 
 
 if __name__ == "__main__":

@@ -68,6 +68,22 @@ def _wait_for_idle(max_wait_s: float = 240.0, load_thresh: float = 0.7):
     return time.monotonic() - t0
 
 
+# --profile: collapsed stacks accumulated across every measured window
+# (the _recipe sample runs — never warmup, engine build, or idle-gate
+# waits). None = unarmed = nothing constructed.
+_profile_stacks: dict | None = None
+
+
+def _measured(run_sample, samples: int) -> list:
+    """Run one attempt's sample windows, under the stack sampler when
+    --profile armed it (the sampler covers exactly the measured
+    region; unarmed runs construct nothing)."""
+    from ray_tpu.util import profiler
+
+    with profiler.accumulate(_profile_stacks):
+        return [run_sample(i) for i in range(samples)]
+
+
 def _recipe(run_sample, *, samples: int, control_key: str,
             attempts: int = 3) -> dict:
     """Round-5 measurement recipe: idle gate, median-of-`samples` for
@@ -76,7 +92,7 @@ def _recipe(run_sample, *, samples: int, control_key: str,
     best = None
     for attempt in range(attempts):
         waited = _wait_for_idle()
-        rows = [run_sample(i) for i in range(samples)]
+        rows = _measured(run_sample, samples)
         keys = [k for k, v in rows[0].items()
                 if isinstance(v, (int, float))]
         agg = {k: float(statistics.median([r[k] for r in rows]))
@@ -349,8 +365,16 @@ def main():
     ap.add_argument("--trace", default=None,
                     help="also dump a chrome trace to this file "
                          "(merged cluster timeline in --serve mode)")
+    ap.add_argument("--profile", action="store_true",
+                    help="arm the stack sampler around the measured "
+                         "windows and write flamegraph-compatible "
+                         ".collapsed stacks next to the --trace "
+                         "artifact")
     args = ap.parse_args()
 
+    global _profile_stacks
+    if args.profile:
+        _profile_stacks = {}
     extra = bench_serve_deployment(args) if args.serve \
         else bench_engine(args)
     secondary = [
@@ -387,6 +411,13 @@ def main():
 
         tracing.dump(args.trace)
         print(f"# wrote trace to {args.trace}")
+    if args.profile:
+        from ray_tpu.util import profiler
+
+        path = (f"{args.trace}.collapsed" if args.trace
+                else "bench_serve.collapsed")
+        profiler.write_collapsed(path, _profile_stacks or {})
+        print(f"# wrote collapsed stacks to {path}")
 
 
 if __name__ == "__main__":

@@ -51,9 +51,11 @@ class _Owned:
 
     __slots__ = ("event", "inline", "value_cached", "has_cached", "location",
                  "store_name", "error", "spec", "retries_left", "borrowers",
-                 "cancelled", "size", "spilled_path")
+                 "cancelled", "size", "spilled_path", "created_at", "label",
+                 "consumed")
 
-    def __init__(self, spec: TaskSpec | None = None, retries_left: int = 0):
+    def __init__(self, spec: TaskSpec | None = None, retries_left: int = 0,
+                 label: str | None = None):
         self.event = threading.Event()
         self.inline: bytes | None = None
         self.value_cached = None
@@ -65,6 +67,15 @@ class _Owned:
         self.retries_left = retries_left
         self.size = 0  # serialized bytes (locality scoring)
         self.spilled_path: str | None = None  # disk tier (spilled primary)
+        # memory-attribution facts (the `ray_tpu memory` / stranded-ref
+        # auditor substrate): when the ref was born, WHAT created it
+        # (task/method name, or put/deferred), and whether any consumer
+        # ever made progress on it (a local get, or serving a borrower's
+        # resolve). A ready-but-never-consumed ref past the age
+        # threshold is the stranded shape the PR-11 traceback pin leaked.
+        self.created_at = time.monotonic()
+        self.label = label or (spec.name if spec is not None else "put")
+        self.consumed = False
         # borrowing processes: rpc address -> borrow EPOCH. The epoch
         # makes deferred releases safe: a stale release from a previous
         # borrow lifecycle of the same process cannot unregister a newer
@@ -176,6 +187,53 @@ def _ack_timeout() -> float:
     return cfg.get("ACK_TIMEOUT_S")
 
 
+# core_task_cpu_seconds_total{kind}: CPU time attributed to task /
+# actor-method execution (lazy-constructed like _coalesced_counter)
+_task_cpu_counter = None
+_task_cpu_lock = threading.Lock()
+
+
+def _task_cpu_observe(kind: str, cpu_s: float):
+    global _task_cpu_counter
+    if _task_cpu_counter is None:
+        with _task_cpu_lock:
+            if _task_cpu_counter is None:
+                try:
+                    from ray_tpu.util.metrics import Counter
+
+                    _task_cpu_counter = Counter(
+                        "core_task_cpu_seconds_total",
+                        "CPU seconds consumed executing tasks and actor "
+                        "methods, by kind", tag_keys=("kind",))
+                except Exception:  # noqa: BLE001
+                    return
+    try:
+        _task_cpu_counter.inc(max(0.0, cpu_s), {"kind": kind})
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _stranded_age_s() -> float:
+    """Age past which a ready-but-never-consumed owned ref counts as
+    stranded (the auditor threshold; env-tunable for tests/ops)."""
+    try:
+        return float(os.environ.get("RAY_TPU_STRANDED_AGE_S", "300"))
+    except ValueError:
+        return 300.0
+
+
+def is_stranded(ready: bool, consumed: bool, borrowers: int,
+                age_s: float, threshold_s: float) -> bool:
+    """THE stranded-ref predicate — the ONE definition shared by the
+    owner-side auditor (the `object_store_stranded_bytes` gauge the
+    watchtower rule watches) and the state API's memory report, so the
+    alert and the report operators chase it with can never disagree
+    about what counts as stranded: ready, past the age threshold, and
+    no consumer progress (never consumed, no live borrower)."""
+    return (bool(ready) and not consumed and not borrowers
+            and age_s >= threshold_s)
+
+
 class ClusterRuntime:
     def __init__(self, address: str | None = None, num_cpus=None, num_tpus=None,
                  resources=None, namespace=None, labels=None, mode="driver",
@@ -270,7 +328,17 @@ class ClusterRuntime:
         self.server.register("pubsub", self._h_pubsub, oneway=True)
         self.server.register("list_objects", self._h_list_objects)
         self.server.register("metrics_text", self._h_metrics_text)
+        # profiler plane: capture handlers block for their window, so
+        # they ride the slow lane; cpu_stats is a cheap table read
+        self.server.register("profile_capture", self._h_profile_capture,
+                             slow=True)
+        self.server.register("cpu_stats", self._h_cpu_stats)
         self.server.register("ping", lambda m, f: "pong")
+        # per-task CPU attribution table: (label, kind) -> [cpu_s, calls]
+        # fed by the worker exec loop via _cpu_account, read by the
+        # cpu_stats RPC (bounded: overflow folds into "_other")
+        self._cpu_by_label: dict[tuple, list] = {}  # guarded_by(_cpu_lock)
+        self._cpu_lock = threading.Lock()
         self.address = self.server.address
 
         if mode == "driver":
@@ -432,7 +500,7 @@ class ClusterRuntime:
         with one stable ref."""
         oid = ObjectID.random()
         b = oid.binary()
-        st = _Owned()
+        st = _Owned(label="deferred")
         with self._lock:
             self._owned[b] = st
 
@@ -581,6 +649,9 @@ class ClusterRuntime:
                 if not st.event.wait(self._remaining(deadline)):
                     raise exc.GetTimeoutError(
                         f"get() timed out waiting for {ref}")
+                # consumer progress: the value (or error) is being
+                # delivered — this ref is no longer a stranded candidate
+                st.consumed = True
                 if st.error is not None:
                     self._raise_stored(st.error)
                 if st.has_cached:
@@ -893,16 +964,95 @@ class ClusterRuntime:
 
     def _h_metrics_text(self, msg, frames):
         """This process's Prometheus page — the scrape surface the
-        nodelet's node_metrics fans out to for every worker."""
-        from ray_tpu.util.metrics import prometheus_text
+        nodelet's node_metrics fans out to for every worker. The
+        stranded-ref gauge is refreshed AT scrape (same discipline as
+        the nodelet's store-occupancy gauges): the auditor scan is one
+        pass over _owned, paid only when somebody actually looks."""
+        from ray_tpu.util.metrics import Gauge, prometheus_text
 
+        try:
+            stranded = self.audit_stranded()
+            Gauge("object_store_stranded_bytes",
+                  "Bytes held by owned refs past the stranded-age "
+                  "threshold with no consumer progress"
+                  ).set(sum(o["size"] for o in stranded))
+        except Exception:  # noqa: BLE001
+            pass
         return {"text": prometheus_text()}
+
+    def _h_profile_capture(self, msg, frames):
+        """Arm this process's stack sampler for the requested window
+        and return collapsed stacks — the leaf of the head→nodelet→
+        worker capture fan-out (the handler thread sleeping the window
+        is the capture; slow lane, so token streams and control calls
+        never queue behind it)."""
+        from ray_tpu.util import profiler
+
+        return profiler.capture_collapsed(
+            msg.get("duration_s", 5.0), hz=msg.get("hz"),
+            max_unique_stacks=msg.get("max_stacks"))
+
+    def _cpu_account(self, label: str, kind: str, cpu_s: float) -> None:
+        """Attribute one execution's thread CPU time: the
+        core_task_cpu_seconds_total{kind} counter plus a bounded
+        per-label table ((task name / ActorClass.method) -> cumulative
+        CPU + call count) served by the cpu_stats RPC."""
+        _task_cpu_observe(kind, cpu_s)
+        with self._cpu_lock:
+            ent = self._cpu_by_label.get((label, kind))
+            if ent is None:
+                if len(self._cpu_by_label) >= 512:
+                    # label-cardinality bound: the tail folds into one
+                    # bucket instead of growing without limit
+                    ent = self._cpu_by_label.setdefault(
+                        ("_other", kind), [0.0, 0])
+                else:
+                    ent = self._cpu_by_label[(label, kind)] = [0.0, 0]
+            ent[0] += max(0.0, cpu_s)
+            ent[1] += 1
+
+    def _h_cpu_stats(self, msg, frames):
+        """This process's per-task/actor-method CPU attribution table
+        (empty on drivers — only exec loops feed it)."""
+        with self._cpu_lock:
+            rows = [{"label": label, "kind": kind,
+                     "cpu_seconds": ent[0], "calls": ent[1]}
+                    for (label, kind), ent in self._cpu_by_label.items()]
+        return {"rows": rows}
+
+    def audit_stranded(self, age_threshold_s: float | None = None
+                       ) -> list[dict]:
+        """The stranded-ref auditor: owned refs that are READY, older
+        than the age threshold, and show no consumer progress — never
+        locally get()-consumed, never served to a borrower, with no
+        live borrower registration. These are the leak shape the PR-11
+        traceback pin produced (refs held alive by accident, that
+        nothing will ever read); `object_store_stranded_bytes` and the
+        watchtower `object-stranded-refs` rule surface the aggregate,
+        this list names the owners/creators."""
+        if age_threshold_s is None:
+            age_threshold_s = _stranded_age_s()
+        now = time.monotonic()
+        out = []
+        with self._lock:
+            for b, st in self._owned.items():
+                age = now - st.created_at
+                if not is_stranded(st.event.is_set(), st.consumed,
+                                   len(st.borrowers), age,
+                                   age_threshold_s):
+                    continue
+                out.append({"object_id": b.hex(), "label": st.label,
+                            "size": st.size, "age_s": round(age, 3),
+                            "error": st.error is not None,
+                            "owner": self.address})
+        return out
 
     def _h_list_objects(self, msg, frames):
         """Owner-side object table for the state API (reference:
         `ray list objects` / `ray memory` aggregate core-worker object
         tables, python/ray/util/state/api.py:1)."""
         out = []
+        now = time.monotonic()
         with self._lock:
             for b, st in self._owned.items():
                 out.append({
@@ -918,6 +1068,9 @@ class ClusterRuntime:
                     "reconstructable": (st.spec is not None
                                         and st.retries_left > 0),
                     "owner": self.address,
+                    "label": st.label,
+                    "age_s": round(now - st.created_at, 3),
+                    "consumed": st.consumed,
                 })
         return {"objects": out}
 
@@ -948,6 +1101,9 @@ class ClusterRuntime:
             st.event.wait(timeout=4.5)
         if not st.event.is_set():
             return {"status": "pending"}
+        # serving a borrower IS consumer progress (the stranded auditor
+        # must not flag refs a remote consumer is actively reading)
+        st.consumed = True
         if st.error is not None:
             return {"status": "error"}, [ser.dumps_msg(st.error)]
         if st.inline is not None:
@@ -2181,7 +2337,7 @@ class ClusterRuntime:
         task_id = TaskID.random().binary()
         with self._lock:
             for o in oids:
-                self._owned[o.binary()] = _Owned()
+                self._owned[o.binary()] = _Owned(label=mname)
             if streaming:
                 self._streams[task_id] = _StreamState(oids[0].binary())
         self._pin_task_args(task_id, ref_oids)

@@ -148,6 +148,10 @@ class Head:
         s.register("dump_timeline", self._h_dump_timeline, slow=True)
         s.register("cluster_metrics", self._h_cluster_metrics, slow=True)
         s.register("metrics_history", self._h_metrics_history, slow=True)
+        # cluster-wide sampling profile: blocks for the capture window
+        # while fanning out to every alive nodelet (never back into this
+        # server's own pool — the GL013 shape)
+        s.register("profile_capture", self._h_profile_capture, slow=True)
         s.register("alerts", self._h_alerts)
         s.register("ping", lambda m, f: "pong")
         # watchtower: the always-on consumer of the scrape fan-out —
@@ -691,6 +695,59 @@ class Head:
         Same read-only discipline as metrics_history."""
         return self.watchtower.alerts_dict(
             include_history=msg.get("history", True))
+
+    def _h_profile_capture(self, msg, frames):
+        """Cluster-wide capture: fan `profile_capture` out to every
+        alive nodelet (which fans out to its workers) under ONE shared
+        deadline while sampling the head's own process, and merge the
+        node-tagged collapsed pages. The same fan-out shape as the
+        metrics scrape — a dead node costs its timeout and a named
+        entry in `errors`, never the capture."""
+        from ray_tpu.util import profiler
+
+        duration = max(0.05, min(float(msg.get("duration_s", 5.0)),
+                                 profiler.MAX_CAPTURE_S))
+        hz = msg.get("hz")
+        with self._lock:
+            targets = [(n.node_id.hex()[:12], n.address)
+                       for n in self._nodes.values() if n.alive]
+        own = profiler.StackSampler(hz=hz).start()
+        # a timer bounds the SELF-sample to exactly the capture window:
+        # a hung nodelet parks call_gather for its full timeout, and an
+        # unbounded own-sampler would then weigh the head ~(timeout/
+        # duration)x heavier than every node page in the merged counts
+        stopper = threading.Timer(duration, own.stop)
+        stopper.daemon = True
+        stopper.start()
+        t0 = time.monotonic()
+        try:
+            results = self.client.call_gather(
+                [(a, "profile_capture", {"duration_s": duration, "hz": hz})
+                 for _, a in targets],
+                timeout=duration + 15.0)
+            rem = duration - (time.monotonic() - t0)
+            if rem > 0:
+                # stop-aware wait: shutdown ends the window early
+                self._stopped.wait(rem)
+        finally:
+            stopper.cancel()
+            own.stop()
+        profiler._note_capture(own)
+        pages = [profiler.prefix_stacks(own.collapsed(),
+                                        "node:head;proc:head")]
+        samples, dropped, procs = own.samples, own.stacks_dropped, 1
+        errors: dict[str, str] = {}
+        for (nid, _), r in zip(targets, results):
+            if r is None:
+                errors[nid] = "capture timed out or node unreachable"
+                continue
+            pages.append(profiler.prefix_stacks(r["stacks"], f"node:{nid}"))
+            samples += r["samples"]
+            dropped += r["dropped"]
+            procs += r["procs"]
+        return {"stacks": profiler.merge_collapsed(pages),
+                "samples": samples, "dropped": dropped, "procs": procs,
+                "errors": errors, "hz": own.hz, "duration_s": duration}
 
     def start_metrics_http(self, port: int = 0) -> int:
         """Serve the cluster-wide /metrics page over HTTP from the head

@@ -235,6 +235,10 @@ class Nodelet:
         # slow lane: fans out to every worker on the node
         s.register("list_node_objects", self._h_list_node_objects, slow=True)
         s.register("node_metrics", self._h_node_metrics, slow=True)
+        # profiler plane: capture blocks for its window + worker fan-out;
+        # cpu stats fan out to every worker's attribution table
+        s.register("profile_capture", self._h_profile_capture, slow=True)
+        s.register("node_cpu_stats", self._h_node_cpu_stats, slow=True)
         s.register("list_logs", self._h_list_logs)
         s.register("tail_log", self._h_tail_log)
         s.register("node_stats", self._h_node_stats)
@@ -1613,6 +1617,75 @@ class Nodelet:
         pages += _metrics.scrape_pages(self.client, targets,
                                        "metrics_text", 5.0, "proc")
         return {"text": _metrics.merge_prometheus(pages)}
+
+    def _h_profile_capture(self, msg, frames):
+        """One node's slice of a cluster profile: fan the capture out to
+        every ready worker via call_gather (ONE shared deadline — a hung
+        worker costs the fan-out its timeout, not timeout-per-worker)
+        while a sampler covers this nodelet's own process for the same
+        window; merge proc-tagged collapsed pages. The head stamps the
+        node tag when it merges node pages."""
+        from ray_tpu.util import profiler
+
+        duration = max(0.05, min(float(msg.get("duration_s", 5.0)),
+                                 profiler.MAX_CAPTURE_S))
+        hz = msg.get("hz")
+        with self._lock:
+            targets = [(w.worker_id.hex()[:12], w.address)
+                       for w in self._workers.values()
+                       if w.address and w.ready.is_set()]
+        own = profiler.StackSampler(hz=hz).start()
+        # timer-bounded self-sample: a hung worker parks call_gather
+        # for its full timeout, which must not weigh this nodelet's
+        # page heavier than its workers' in the merged counts
+        stopper = threading.Timer(duration, own.stop)
+        stopper.daemon = True
+        stopper.start()
+        t0 = time.monotonic()
+        try:
+            results = self.client.call_gather(
+                [(a, "profile_capture", {"duration_s": duration, "hz": hz})
+                 for _, a in targets],
+                timeout=duration + 10.0)
+            # hold the local window open for its full length even when
+            # the worker fan-out returns early (e.g. zero workers);
+            # stop-aware so shutdown ends the window early
+            rem = duration - (time.monotonic() - t0)
+            if rem > 0:
+                self._stopped.wait(rem)
+        finally:
+            stopper.cancel()
+            own.stop()
+        profiler._note_capture(own)
+        pages = [profiler.prefix_stacks(own.collapsed(), "proc:nodelet")]
+        samples, dropped, procs = own.samples, own.stacks_dropped, 1
+        for (wid, _), r in zip(targets, results):
+            if r is None:
+                continue  # dead/slow worker: the rest of the page stands
+            pages.append(profiler.prefix_stacks(r["stacks"], f"proc:{wid}"))
+            samples += r["samples"]
+            dropped += r["dropped"]
+            procs += 1
+        return {"stacks": profiler.merge_collapsed(pages),
+                "samples": samples, "dropped": dropped, "procs": procs,
+                "hz": own.hz}
+
+    def _h_node_cpu_stats(self, msg, frames):
+        """Aggregate every ready worker's per-task CPU attribution
+        table (one call_gather pass, proc-tagged rows)."""
+        with self._lock:
+            targets = [(w.worker_id.hex()[:12], w.address)
+                       for w in self._workers.values()
+                       if w.address and w.ready.is_set()]
+        results = self.client.call_gather(
+            [(a, "cpu_stats", {}) for _, a in targets], timeout=5.0)
+        rows = []
+        for (wid, _), r in zip(targets, results):
+            if r is None:
+                continue
+            for row in r.get("rows", ()):
+                rows.append({**row, "proc": wid})
+        return {"rows": rows, "node_id": self.node_id}
 
     def _h_list_node_objects(self, msg, frames):
         """Aggregate this node's owner-side object tables + store stats

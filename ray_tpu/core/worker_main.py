@@ -420,6 +420,10 @@ class WorkerRuntime(ClusterRuntime):
         # link to this task (reference: tracing_helper.py:34 propagation)
         self._ctx.trace = spec.trace
         t_start = time.monotonic()
+        # per-task CPU attribution: thread_time deltas on the executing
+        # thread feed core_task_cpu_seconds_total{kind} + the cpu_stats
+        # table (two clock reads per task — noise-level cost)
+        t_cpu0 = time.thread_time()
         try:
             fn = self._fetch_fn(spec.fn_id)
             a, kw = self._decode_args(spec.args, spec.kwargs)
@@ -457,6 +461,8 @@ class WorkerRuntime(ClusterRuntime):
             self._report_task_event(spec.task_id, spec.name, "FAILED",
                                     t_start, "NORMAL_TASK")
         finally:
+            self._cpu_account(spec.name, "task",
+                              time.thread_time() - t_cpu0)
             self._ctx.task_id = None
             if notify_nodelet:
                 try:
@@ -576,6 +582,11 @@ class WorkerRuntime(ClusterRuntime):
             self._ctx.task_id = TaskID(task_id) if task_id else None
             self._ctx.trace = msg.get("trace")
             t_start = time.monotonic()
+            # CPU attribution per method call (async methods account
+            # only their dispatch sliver — the coroutine body runs on
+            # the shared event loop, where thread_time would attribute
+            # OTHER coroutines' work to this call)
+            t_cpu0 = time.thread_time()
             label = f"{type(self._actor_instance).__name__}.{mname}"
             try:
                 a, kw = self._decode_args(msg["args"], msg["kwargs"])
@@ -634,6 +645,8 @@ class WorkerRuntime(ClusterRuntime):
                 self._report_task_event(task_id, label, "FAILED", t_start,
                                         "ACTOR_TASK")
             finally:
+                self._cpu_account(label, "actor",
+                                  time.thread_time() - t_cpu0)
                 if inbox.empty():
                     # group inbox drained: callers are (about to be)
                     # blocked on these results — flush buffered dones

@@ -33,6 +33,7 @@ _GROUPS = (
     ("serve_slo", "Serving SLO attribution"),
     ("serve", "Serve proxy"),
     ("rl", "RL flywheel"),
+    ("profile", "Profiler plane"),
     ("spans", "Span plane"),
     ("watchtower", "Alerts"),
 )

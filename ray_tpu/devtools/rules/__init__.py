@@ -19,4 +19,5 @@ from ray_tpu.devtools.rules import (  # noqa: F401
     sequential_rpc,
     spmd_nondeterminism,
     store_refcount,
+    wallclock_duration,
 )

@@ -1,0 +1,109 @@
+"""GL015: wall-clock deltas used as durations.
+
+``time.time()`` readings subtract to intervals that jump under NTP
+slew, leap smearing, and operator clock steps — the PR 3
+epoch-anchoring bug class: a span plane stamped with raw wall deltas
+mis-ordered cross-process events by each machine's clock adjustment.
+The repo's clock discipline (OBSERVABILITY.md) is: **durations come
+from the monotonic clock** (`time.monotonic()` / `monotonic_ns` /
+`perf_counter` / `thread_time`), **timestamps come from the wall
+clock**, and the only sanctioned mix is the epoch anchor
+``time.time() - time.monotonic()`` recorded once and added to
+monotonic readings.
+
+Heuristic: flag a ``-`` subtraction where BOTH operands are wall-clock
+readings — a direct ``time.time()`` call, or a name/attribute ASSIGNED
+from ``time.time()`` anywhere in the module (module-wide tracking
+matches how ``t0``-style locals and ``self._start``-style attributes
+are actually used; a name also assigned from a monotonic source
+anywhere is treated as NOT wall, keeping the rule conservative).
+Quiet by construction:
+
+- timestamps stored without subtraction (record fields, session names);
+- the anchoring idiom ``time.time() - time.monotonic()`` (one operand
+  is monotonic);
+- ``deadline - time.time()`` where ``deadline``'s provenance is
+  unknown (only *known-wall* operands fire).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools.context import ModuleContext, qualname
+from ray_tpu.devtools.registry import Rule, register
+
+_MONO_FNS = {"monotonic", "monotonic_ns", "perf_counter",
+             "perf_counter_ns", "thread_time", "thread_time_ns",
+             "process_time", "process_time_ns"}
+
+
+def _call_kind(node: ast.AST) -> str | None:
+    """'wall' / 'mono' for a time-module call expression, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    q = qualname(node.func)
+    if q == "time.time":
+        return "wall"
+    if q is not None and "." in q and q.split(".")[-1] in _MONO_FNS:
+        return "mono"
+    return None
+
+
+@register
+class WallclockDurationRule(Rule):
+    name = "wallclock-duration"
+    code = "GL015"
+    description = ("time.time() delta used as a duration — wall-clock "
+                   "subtraction jumps under NTP slew/clock steps; "
+                   "durations must come from time.monotonic()")
+    invariant = ("durations are monotonic-clock differences; the wall "
+                 "clock only stamps timestamps (and the once-per-process "
+                 "epoch anchor)")
+    interests = ("Assign", "BinOp")
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        self._wall_names: set[str] = set()
+        self._mono_names: set[str] = set()
+        self._subs: list[ast.BinOp] = []
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if isinstance(node, ast.Assign):
+            kind = _call_kind(node.value)
+            if kind is None:
+                return
+            names = self._wall_names if kind == "wall" else \
+                self._mono_names
+            for target in node.targets:
+                q = qualname(target)
+                if q is not None:
+                    names.add(q)
+        elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+            self._subs.append(node)
+
+    def _wallness(self, node: ast.AST) -> str | None:
+        kind = _call_kind(node)
+        if kind is not None:
+            return kind
+        q = qualname(node)
+        if q is None:
+            return None
+        # a name fed from BOTH clocks anywhere in the module is
+        # ambiguous: treat as monotonic (no finding) — conservative
+        if q in self._mono_names:
+            return "mono"
+        if q in self._wall_names:
+            return "wall"
+        return None
+
+    def end_module(self, ctx: ModuleContext) -> None:
+        for sub in self._subs:
+            if self._wallness(sub.left) == "wall" and \
+                    self._wallness(sub.right) == "wall":
+                ctx.report(self, sub,
+                           "wall-clock delta used as a duration: both "
+                           "operands of this subtraction are "
+                           "time.time() readings, which jump under NTP "
+                           "slew/clock steps — time the interval with "
+                           "time.monotonic() (keep time.time() for "
+                           "timestamps)")

@@ -11,6 +11,7 @@ Reference parity: python/ray/scripts/scripts.py — `ray start --head`,
   python -m ray_tpu.scripts.cli timeline --address HOST:PORT -o out.json
   python -m ray_tpu.scripts.cli metrics  --address HOST:PORT
   python -m ray_tpu.scripts.cli alerts   --address HOST:PORT [--json]
+  python -m ray_tpu.scripts.cli profile  --address HOST:PORT [-d SECS]
   python -m ray_tpu.scripts.cli debug-dump --address HOST:PORT [-o DIR]
   python -m ray_tpu.scripts.cli stop   [--session-dir DIR]
 """
@@ -203,6 +204,29 @@ def cmd_alerts(args):
     return 0
 
 
+def cmd_profile(args):
+    """Cluster-wide sampling profile: arm a capture window in every
+    process (head, nodelets, workers, this CLI excluded) and write
+    merged node/proc-tagged collapsed stacks — feed the .collapsed file
+    to flamegraph.pl / speedscope, or --chrome for a chrome://tracing
+    flame view."""
+    from ray_tpu.util import profiler, state
+
+    r = state.profile(duration_s=args.duration, hz=args.hz,
+                      address=args.address, include_driver=False)
+    profiler.write_collapsed(args.output, r["stacks"])
+    print(f"wrote {len(r['stacks'])} unique stacks to {args.output} "
+          f"({r['samples']} samples @ {r['hz']:g}Hz across "
+          f"{r['procs']} procs, {r['dropped']} dropped)")
+    for nid, err in sorted(r.get("errors", {}).items()):
+        print(f"  MISSING node {nid}: {err}", file=sys.stderr)
+    if args.chrome:
+        profiler.collapsed_to_chrome(r["stacks"], r["hz"],
+                                     filename=args.chrome)
+        print(f"wrote chrome flame view to {args.chrome}")
+    return 0
+
+
 def cmd_debug_dump(args):
     """Flight recorder: one post-mortem directory — state listings,
     memory report, serve/llm status, merged timeline, cluster metrics,
@@ -353,6 +377,19 @@ def main(argv=None):
     p.add_argument("--limit", type=int, default=20,
                    help="transition-history lines to show")
     p.set_defaults(fn=cmd_alerts)
+
+    p = sub.add_parser("profile",
+                       help="cluster-wide sampling profile -> "
+                            "flamegraph-compatible .collapsed stacks")
+    p.add_argument("--address", required=True)
+    p.add_argument("-d", "--duration", type=float, default=5.0,
+                   help="capture window in seconds (default 5)")
+    p.add_argument("--hz", type=float, default=None,
+                   help="sampling rate (default 25)")
+    p.add_argument("-o", "--output", default="profile.collapsed")
+    p.add_argument("--chrome", default=None,
+                   help="also write a chrome-trace flame view here")
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("debug-dump",
                        help="write a one-call post-mortem directory "

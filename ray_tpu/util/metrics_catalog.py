@@ -65,6 +65,10 @@ CATALOG: list[dict] = [
     {"name": "object_store_created_bytes_total", "type": "counter",
      "where": "ray_tpu/core/object_store.py",
      "what": "per-process store writes (bytes)"},
+    {"name": "object_store_stranded_bytes", "type": "gauge",
+     "where": "ray_tpu/core/cluster_runtime.py",
+     "what": "bytes held by owned refs past the stranded-age threshold "
+             "with no consumer progress (refreshed at scrape)"},
     # serve.llm engine
     {"name": "serve_llm_tokens_generated_total", "type": "counter",
      "where": "ray_tpu/serve/llm/engine.py", "what": "tokens generated"},
@@ -168,6 +172,20 @@ CATALOG: list[dict] = [
     {"name": "dag_executions_total", "type": "counter",
      "where": "ray_tpu/dag/__init__.py",
      "what": "compiled-DAG executions, by path (compiled|eager_fallback)"},
+    # profiler plane
+    {"name": "core_task_cpu_seconds_total", "type": "counter",
+     "where": "ray_tpu/core/cluster_runtime.py",
+     "what": "CPU seconds consumed executing tasks and actor methods, "
+             "by kind (fed by the worker exec loop)"},
+    {"name": "profile_captures_total", "type": "counter",
+     "where": "ray_tpu/util/profiler.py",
+     "what": "sampling-profiler capture windows completed"},
+    {"name": "profile_samples_total", "type": "counter",
+     "where": "ray_tpu/util/profiler.py",
+     "what": "stack sample ticks taken across capture windows"},
+    {"name": "profile_stacks_dropped_total", "type": "counter",
+     "where": "ray_tpu/util/profiler.py",
+     "what": "thread-samples rejected by the unique-stack cap"},
     # span plane
     {"name": "spans_sampled_total", "type": "counter",
      "where": "ray_tpu/utils/events.py",

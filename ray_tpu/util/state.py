@@ -96,6 +96,86 @@ def alerts(address: str | None = None, include_history: bool = True,
                       address=address, timeout=timeout)
 
 
+def profile(duration_s: float = 5.0, hz: float | None = None,
+            address: str | None = None, include_driver: bool = True,
+            timeout: float | None = None) -> dict:
+    """Cluster-wide sampling profile: the head arms a capture window in
+    every process — head, each alive nodelet, each ready worker — via
+    the `profile_capture` fan-out (one shared deadline, the metrics
+    scrape shape), and this driver samples itself in parallel. Returns
+    ``{"stacks": {collapsed: count}, "samples", "dropped", "procs",
+    "errors", "hz", "duration_s"}`` where each collapsed stack is
+    prefixed with ``node:<id>;proc:<id>`` pseudo-frames, ready for
+    `profiler.collapsed_text` / flamegraph tooling. Dormant processes
+    pay nothing outside the window; see OBSERVABILITY.md "Profiling &
+    memory attribution" for the capture contract."""
+    import threading
+
+    from ray_tpu.core import api as _api
+    from ray_tpu.util import profiler
+
+    local: dict = {}
+    th = None
+    if include_driver and _api._runtime is not None:
+        def _local_capture():
+            local.update(profiler.capture_collapsed(duration_s, hz=hz))
+
+        th = threading.Thread(target=_local_capture, daemon=True,
+                              name="profile-driver-capture")
+        th.start()
+    if timeout is None:
+        timeout = float(duration_s) + 30.0
+    r = _head_call("profile_capture",
+                   {"duration_s": duration_s, "hz": hz},
+                   address=address, timeout=timeout)
+    if th is not None:
+        th.join(timeout=float(duration_s) + 10.0)
+    if local:
+        r["stacks"] = profiler.merge_collapsed([
+            r["stacks"],
+            profiler.prefix_stacks(local["stacks"],
+                                   "node:driver;proc:driver")])
+        r["samples"] += local["samples"]
+        r["dropped"] += local["dropped"]
+        r["procs"] += 1
+    return r
+
+
+def cpu_attribution(address: str | None = None, top_n: int = 20,
+                    timeout: float = 20) -> dict:
+    """Per-task / per-actor-method CPU attribution, cluster-wide: every
+    worker's exec loop accounts `time.thread_time` deltas by (label,
+    kind); this aggregates the tables across all alive nodes and
+    returns the top-N by cumulative CPU — ``{"rows": [{label, kind,
+    cpu_seconds, calls, procs}], "total_cpu_seconds"}``. The straggler
+    question ("which actor method is eating the node?") as a lookup
+    instead of a profiling session."""
+    from ray_tpu.core.rpc import RpcClient
+
+    agg: dict[tuple, dict] = {}
+    for n in list_nodes(address, timeout=timeout):
+        if not n["alive"]:
+            continue
+        try:
+            r = RpcClient.shared().call(n["address"], "node_cpu_stats",
+                                        {}, timeout=timeout)
+        except Exception:  # noqa: BLE001
+            continue
+        for row in r.get("rows", ()):
+            key = (row["label"], row["kind"])
+            ent = agg.setdefault(key, {"label": row["label"],
+                                       "kind": row["kind"],
+                                       "cpu_seconds": 0.0, "calls": 0,
+                                       "procs": 0})
+            ent["cpu_seconds"] += row["cpu_seconds"]
+            ent["calls"] += row["calls"]
+            ent["procs"] += 1
+    rows = sorted(agg.values(), key=lambda e: -e["cpu_seconds"])
+    return {"rows": rows[:top_n],
+            "total_cpu_seconds": sum(e["cpu_seconds"]
+                                     for e in agg.values())}
+
+
 def cluster_timeline(address: str | None = None,
                      filename: str | None = None, timeout: float = 30):
     """The merged cluster chrome trace from the head's span buffer
@@ -203,31 +283,97 @@ def list_objects(address: str | None = None,
     return _node_object_tables(address, timeout)[1]
 
 
-def memory_summary(address: str | None = None,
-                   timeout: float = 20) -> dict:
-    """Per-node store usage + per-owner object footprint (reference:
-    the `ray memory` report)."""
+_AGE_BUCKETS = ((60.0, "<1m"), (300.0, "1-5m"), (float("inf"), ">5m"))
+
+
+def _age_bucket(age_s: float) -> str:
+    for bound, name in _AGE_BUCKETS:
+        if age_s < bound:
+            return name
+    return _AGE_BUCKETS[-1][1]
+
+
+def _attr_agg(table: dict, key: str, o: dict, stranded: bool) -> None:
+    agg = table.setdefault(key, {
+        "count": 0, "bytes": 0, "spilled": 0, "borrowed": 0,
+        "stranded_count": 0, "stranded_bytes": 0,
+        "ages": {name: 0 for _, name in _AGE_BUCKETS}})
+    size = o.get("size", 0) or 0
+    agg["count"] += 1
+    agg["bytes"] += size
+    agg["spilled"] += 1 if o.get("spilled") else 0
+    agg["borrowed"] += o.get("borrowers", 0)
+    agg["ages"][_age_bucket(o.get("age_s", 0.0))] += 1
+    if stranded:
+        agg["stranded_count"] += 1
+        agg["stranded_bytes"] += size
+
+
+def memory_summary(address: str | None = None, timeout: float = 20,
+                   stranded_age_s: float | None = None) -> dict:
+    """Per-node store usage + per-owner AND per-creator object
+    attribution with age buckets and the stranded-ref audit (reference:
+    the `ray memory` report). A ref counts as STRANDED when it is
+    ready, older than `stranded_age_s` (default
+    ``RAY_TPU_STRANDED_AGE_S``, 300s), and shows no consumer progress —
+    never get()-consumed, never served to a borrower, no live
+    borrower. `by_label` groups by what CREATED the object (task /
+    actor-method name, `put`, `deferred`), which is what names the
+    leaking code path."""
+    from ray_tpu.core.cluster_runtime import _stranded_age_s, is_stranded
+
+    if stranded_age_s is None:
+        stranded_age_s = _stranded_age_s()
     nodes, objects = _node_object_tables(address, timeout)
     by_owner: dict[str, dict] = {}
+    by_label: dict[str, dict] = {}
+    stranded_rows: list[dict] = []
     for o in objects:
-        agg = by_owner.setdefault(o["owner"], {"count": 0, "bytes": 0,
-                                               "spilled": 0, "borrowed": 0})
-        agg["count"] += 1
-        agg["bytes"] += o.get("size", 0) or 0
-        agg["spilled"] += 1 if o.get("spilled") else 0
-        agg["borrowed"] += o.get("borrowers", 0)
+        # the ONE predicate the auditor gauge uses — report and alert
+        # can never disagree about what counts as stranded
+        stranded = is_stranded(o.get("ready", False),
+                               o.get("consumed", False),
+                               o.get("borrowers", 0),
+                               o.get("age_s", 0.0), stranded_age_s)
+        _attr_agg(by_owner, o["owner"], o, stranded)
+        _attr_agg(by_label, o.get("label") or "?", o, stranded)
+        if stranded:
+            stranded_rows.append(o)
+    stranded_rows.sort(key=lambda o: -(o.get("size", 0) or 0))
     return {
         "nodes": nodes,
         "objects_total": len(objects),
         "objects_bytes": sum((o.get("size") or 0) for o in objects),
         "by_owner": by_owner,
+        "by_label": by_label,
+        "stranded_age_s": stranded_age_s,
+        "stranded": {
+            "count": len(stranded_rows),
+            "bytes": sum((o.get("size") or 0) for o in stranded_rows),
+            "top": stranded_rows[:20],
+        },
     }
 
 
-def memory_report(address: str | None = None,
-                  timeout: float = 20) -> str:
-    """Human-readable `ray_tpu memory` view."""
-    s = memory_summary(address, timeout)
+def _attr_lines(title: str, table: dict) -> list[str]:
+    lines = [title]
+    for key, agg in sorted(table.items(), key=lambda kv: -kv[1]["bytes"]):
+        ages = " ".join(f"{name}={agg['ages'][name]}"
+                        for _, name in _AGE_BUCKETS)
+        lines.append(
+            f"  {key:<28} count={agg['count']:<6} "
+            f"bytes={agg['bytes'] / (1 << 20):8.1f}MB "
+            f"spilled={agg['spilled']:<4} borrowed={agg['borrowed']:<4} "
+            f"stranded={agg['stranded_count']:<4} ages[{ages}]")
+    return lines
+
+
+def memory_report(address: str | None = None, timeout: float = 20,
+                  stranded_age_s: float | None = None) -> str:
+    """Human-readable `ray_tpu memory` view: per-node store usage, the
+    per-owner and per-creator attribution tables, and the stranded-ref
+    audit."""
+    s = memory_summary(address, timeout, stranded_age_s)
     lines = ["=== object store per node ==="]
     for n in s["nodes"]:
         cap = n["store_capacity"] or 1
@@ -239,12 +385,19 @@ def memory_report(address: str | None = None,
             f"oom_kills={n['oom_kills']}")
     lines.append(f"=== owned objects: {s['objects_total']} "
                  f"({s['objects_bytes'] / (1 << 20):.1f}MB) ===")
-    for owner, agg in sorted(s["by_owner"].items(),
-                             key=lambda kv: -kv[1]["bytes"]):
+    lines += _attr_lines("=== by owner ===", s["by_owner"])
+    lines += _attr_lines("=== by creator ===", s["by_label"])
+    st = s["stranded"]
+    lines.append(
+        f"=== stranded refs (age > {s['stranded_age_s']:g}s, no consumer "
+        f"progress): {st['count']} ({st['bytes'] / (1 << 20):.1f}MB) ===")
+    for o in st["top"]:
         lines.append(
-            f"  {owner:<21} count={agg['count']:<6} "
-            f"bytes={agg['bytes'] / (1 << 20):8.1f}MB "
-            f"spilled={agg['spilled']:<4} borrowed={agg['borrowed']}")
+            f"  {o['object_id'][:16]} label={o.get('label', '?'):<24} "
+            f"owner={o['owner']:<21} "
+            f"bytes={(o.get('size') or 0) / (1 << 20):8.1f}MB "
+            f"age={o.get('age_s', 0.0):8.1f}s "
+            f"error={bool(o.get('error'))}")
     return "\n".join(lines)
 
 
@@ -353,7 +506,15 @@ def debug_dump(out_dir: str | None = None, address: str | None = None,
         <dir>/timeline.json             merged chrome trace
         <dir>/metrics.prom              cluster Prometheus page
         <dir>/alerts.json               watchtower alerts + transitions
+        <dir>/profile.collapsed         short cluster stack capture
         <dir>/logs/<node12>/<file>      per-node log tails
+
+    ``memory.txt`` is the full attribution report (per-owner +
+    per-creator tables, age buckets, the stranded-ref audit);
+    ``profile.collapsed`` is a best-effort ~2s cluster-wide sampling
+    capture (flamegraph-compatible), taken only while real budget
+    remains — success or failure lands in ``summary.json`` like every
+    other artifact.
     """
     import json
     import os
@@ -426,6 +587,26 @@ def debug_dump(out_dir: str | None = None, address: str | None = None,
          lambda: cluster_timeline(
              address, os.path.join(out_dir, "timeline.json"),
              timeout=budget()))
+
+    # short cluster profile: where every process's threads were while
+    # the incident was live (the alert-triggered autodump path rides
+    # this too, so a critical firing captures a flamegraph for free).
+    # The capture costs its window in wall time, so it runs only while
+    # real budget remains beyond the window.
+    if deadline - time.monotonic() > 8.0:
+        def _profile():
+            from ray_tpu.util import profiler
+
+            # the remaining dump budget bounds the whole capture RPC —
+            # a hung node must cost this STEP its timeout, never
+            # stretch the dump past deadline_s like every other step
+            r = profile(duration_s=min(2.0, budget(5.0) / 2),
+                        address=address, timeout=budget())
+            return profiler.collapsed_text(r["stacks"])
+
+        step("profile", _profile, twrite("profile.collapsed"))
+    else:
+        summary["errors"]["profile"] = "insufficient budget left"
 
     # serve control plane (needs a connected runtime; absent serve apps
     # are an error entry, not a failure). serve.status()'s internal

@@ -448,6 +448,20 @@ def default_rules() -> list[WatchRule]:
             description="train step counter stopped increasing for "
                         "2min after prior activity — a hung gang "
                         "(deadlocked collective, dead worker)"),
+        WatchRule(
+            "object-stranded-refs",
+            metric="object_store_stranded_bytes",
+            stat="last", agg="sum", op=">",
+            threshold=float(os.environ.get(
+                "RAY_TPU_WATCHTOWER_STRANDED_BYTES",
+                str(128 << 20))),
+            window_s=120, for_s=30, severity="warning",
+            description="owned refs past the stranded-age threshold "
+                        "with no consumer progress are holding more "
+                        "bytes than RAY_TPU_WATCHTOWER_STRANDED_BYTES "
+                        "(default 128MB) — the stranded-oid leak "
+                        "shape; `ray_tpu memory` names the "
+                        "owner/creator"),
     ]
 
 

@@ -1,0 +1,28 @@
+"""GL015 firing fixture: time.time() deltas used as durations."""
+
+import time
+
+
+def work():
+    pass
+
+
+def elapsed_direct():
+    t0 = time.time()
+    work()
+    return time.time() - t0  # FIRE: wall call minus wall-assigned name
+
+
+class Timer:
+    def begin(self):
+        self._start = time.time()
+
+    def end(self):
+        self._end = time.time()
+        return self._end - self._start  # FIRE: both attrs wall-assigned
+
+
+def spin_budget():
+    start = time.time()
+    while time.time() - start < 5.0:  # FIRE: wall-vs-wall loop budget
+        work()

@@ -1,0 +1,32 @@
+"""GL015 clean fixture: timestamps, monotonic durations, the anchor."""
+
+import time
+
+# the sanctioned epoch anchor: one wall operand, one monotonic operand
+_WALL_ANCHOR = time.time() - time.monotonic()
+
+
+def work():
+    pass
+
+
+def stamp() -> dict:
+    # timestamps without subtraction are what time.time() is FOR
+    return {"time": time.time(), "session": f"s_{int(time.time())}"}
+
+
+def elapsed() -> float:
+    t0 = time.monotonic()
+    work()
+    return time.monotonic() - t0  # monotonic duration: correct
+
+
+def remaining(deadline: float) -> float:
+    # unknown provenance on `deadline`: only known-wall operands fire
+    return deadline - time.time()
+
+
+def cpu_elapsed() -> float:
+    c0 = time.thread_time()
+    work()
+    return time.thread_time() - c0

@@ -43,6 +43,7 @@ RULE_CASES = [
     ("GL012", "blocking-under-lock", "gl012_fire.py", "gl012_ok.py", 3),
     ("GL013", "handler-reentry", "gl013_fire.py", "gl013_ok.py", 3),
     ("GL014", "sequential-rpc-in-loop", "gl014_fire.py", "gl014_ok.py", 3),
+    ("GL015", "wallclock-duration", "gl015_fire.py", "gl015_ok.py", 3),
 ]
 
 
@@ -64,7 +65,8 @@ def test_rule_catalog_complete():
     catalog = rule_catalog()
     assert [c.code for c in catalog] == [
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-        "GL008", "GL009", "GL010", "GL011", "GL012", "GL013", "GL014"]
+        "GL008", "GL009", "GL010", "GL011", "GL012", "GL013", "GL014",
+        "GL015"]
     for cls in catalog:
         assert cls.name and cls.description and cls.invariant
 
