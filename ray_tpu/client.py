@@ -238,8 +238,16 @@ class _ClientHost:
 def _client_host_main():
     head = os.environ["RAY_TPU_HEAD_ADDR"]
     host = _ClientHost(head)
-    # hand our address to the spawning proxy over stdout
+    # hand our address to the spawning proxy over stdout — PROTOCOL
+    # output the parent parses line-by-line, not logging
+    # graftlint: disable=bare-print
     print(f"CLIENT_HOST_ADDR {host.rt.address}", flush=True)
+    # the proxy stops reading this pipe after the handshake line: any
+    # later stdout (e.g. worker prints mirrored here under
+    # RAY_TPU_LOG_TO_DRIVER) would fill the ~64KB pipe and BLOCK the
+    # writing RPC thread forever — detach to devnull; the runtime's
+    # bounded mirror ring still retains mirrored lines for the client
+    sys.stdout = open(os.devnull, "w")
     host.serve_forever()
 
 

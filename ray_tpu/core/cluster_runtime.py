@@ -116,6 +116,11 @@ class _Context(threading.local):
         # active trace context (OTel-style span propagation — reference:
         # tracing_helper.py:34 _inject_tracing_into_function)
         self.trace = None
+        # log-plane attribution for the executing thread: the task's
+        # display label and its owner's address (the mirror target for
+        # captured prints when RAY_TPU_LOG_TO_DRIVER is armed)
+        self.task_name = None
+        self.task_owner = None
 
 
 # span-context derivation lives with the event log now (utils/events.py)
@@ -326,6 +331,8 @@ class ClusterRuntime:
         self.server.register("borrow_release", self._h_borrow_release,
                              oneway=True)
         self.server.register("pubsub", self._h_pubsub, oneway=True)
+        self.server.register("driver_log", self._h_driver_log,
+                             oneway=True)
         self.server.register("list_objects", self._h_list_objects)
         self.server.register("metrics_text", self._h_metrics_text)
         # profiler plane: capture handlers block for their window, so
@@ -339,6 +346,9 @@ class ClusterRuntime:
         # cpu_stats RPC (bounded: overflow folds into "_other")
         self._cpu_by_label: dict[tuple, list] = {}  # guarded_by(_cpu_lock)
         self._cpu_lock = threading.Lock()
+        # worker prints mirrored here by the log plane when
+        # RAY_TPU_LOG_TO_DRIVER is armed (bounded; appends are atomic)
+        self._mirrored_logs: _deque = _deque(maxlen=500)
         self.address = self.server.address
 
         if mode == "driver":
@@ -961,6 +971,54 @@ class ClusterRuntime:
         return fut
 
     # -- owner-side handlers --------------------------------------------------
+
+    def _h_driver_log(self, msg, frames):
+        """Worker print mirrored to this (owning) process — the
+        RAY_TPU_LOG_TO_DRIVER ergonomic: the raw line lands on the
+        driver console with a `(task pid=…, node=…)` prefix, exactly
+        the reference's worker-print forwarding. Also retained in the
+        bounded `_mirrored_logs` ring so tests and tooling can read
+        what was mirrored without scraping a console."""
+        entry = {k: msg.get(k) for k in
+                 ("line", "source", "task", "task_id", "node", "pid")}
+        self._mirrored_logs.append(entry)
+        prefix = (f"({entry.get('task') or '?'} "
+                  f"pid={entry.get('pid') or '?'}, "
+                  f"node={entry.get('node') or '?'})")
+        try:
+            import sys as _sys
+
+            stream = (_sys.stderr if entry.get("source") == "stderr"
+                      else _sys.stdout)
+            # the mirror's whole purpose is the driver console — the
+            # one sanctioned raw print outside CLI entry points
+            # graftlint: disable=bare-print
+            print(f"{prefix} {entry.get('line', '')}", file=stream,
+                  flush=True)
+        except Exception:  # noqa: BLE001
+            pass  # console gone (piped/closed): the ring still has it
+
+    def _mirror_stream_line(self, line: str, source: str) -> None:
+        """Capture hook (worker side): forward one captured print line
+        to the executing task's owner. Armed only when
+        RAY_TPU_LOG_TO_DRIVER is set — unarmed workers never install
+        this, so the print hot path pays nothing. Best-effort oneway:
+        a dead owner loses mirrored lines, never the task."""
+        ctx = self._ctx
+        owner = getattr(ctx, "task_owner", None)
+        if not owner:
+            return
+        try:
+            self.client.send_oneway(owner, "driver_log", {
+                "line": line, "source": source,
+                "task": getattr(ctx, "task_name", None),
+                "task_id": ctx.task_id.hex() if ctx.task_id else None,
+                "node": self.node_id.hex()[:12]
+                if getattr(self, "node_id", None) else None,
+                "pid": os.getpid(),
+            })
+        except Exception:  # noqa: BLE001
+            pass
 
     def _h_metrics_text(self, msg, frames):
         """This process's Prometheus page — the scrape surface the

@@ -67,6 +67,13 @@ _DEFS: dict[str, tuple[type, Any, str]] = {
     # --- tasks
     "TASK_MAX_RETRIES": (int, 3, "default task retry budget"),
     "ACK_TIMEOUT_S": (float, 30.0, "submission enqueue-ack deadline"),
+    # --- log plane
+    "LOG_TO_DRIVER": (bool, False,
+                      "mirror captured worker prints to the submitting "
+                      "driver with a (task, node) prefix"),
+    "LOG_MAX_BYTES": (int, 32 * 1024 * 1024,
+                      "per-process structured JSONL log budget "
+                      "(two-file rotation)"),
 }
 
 _lock = threading.Lock()

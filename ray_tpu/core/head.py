@@ -152,6 +152,10 @@ class Head:
         # while fanning out to every alive nodelet (never back into this
         # server's own pool — the GL013 shape)
         s.register("profile_capture", self._h_profile_capture, slow=True)
+        # structured-log fan-out: one call_gather sweep over alive
+        # nodelets' log_query under ONE shared deadline (a dead node =
+        # an `errors` entry, the profile-capture shape)
+        s.register("cluster_logs", self._h_cluster_logs, slow=True)
         s.register("alerts", self._h_alerts)
         s.register("ping", lambda m, f: "pong")
         # watchtower: the always-on consumer of the scrape fan-out —
@@ -167,7 +171,8 @@ class Head:
             autodump=watchtower_autodump,
             autodump_cooldown_s=watchtower_autodump_cooldown_s,
             address_fn=lambda: self.address,
-            span_sink=self._ingest_spans)
+            span_sink=self._ingest_spans,
+            log_context_fn=self._watchtower_log_context)
         self._monitor = threading.Thread(target=self._monitor_loop, daemon=True,
                                          name="head-monitor")
         self._pg_retry = threading.Thread(target=self._pg_retry_loop,
@@ -695,6 +700,90 @@ class Head:
         Same read-only discipline as metrics_history."""
         return self.watchtower.alerts_dict(
             include_history=msg.get("history", True))
+
+    def _gather_cluster_logs(self, query: dict, timeout_s: float) -> dict:
+        """One structured-log sweep: fan `log_query` out to every alive
+        nodelet via call_gather (ONE shared deadline — a stopped node
+        costs at most `timeout_s` and lands in `errors`, never fails
+        the gather), merge the pages ts-sorted, thread per-node follow
+        offsets through. Shared by the `cluster_logs` RPC handler and
+        the watchtower's alert-context fetch (which runs on the
+        watchtower thread — never back into this server's own pool,
+        the GL013 shape)."""
+        node_filter = query.get("node")
+        with self._lock:
+            targets = [(n.node_id.hex()[:12], n.address)
+                       for n in self._nodes.values() if n.alive]
+        if node_filter:
+            targets = [(nid, a) for nid, a in targets
+                       if nid.startswith(node_filter)]
+        offsets = query.get("offsets") or {}
+        limit = max(1, min(int(query.get("limit") or 1000), 5000))
+        calls = []
+        for nid, addr in targets:
+            q = {k: query.get(k) for k in
+                 ("level", "grep", "since", "until", "trace_id",
+                  "task", "proc")}
+            # the DEFAULTED limit, not the caller's raw value — a query
+            # omitting "limit" must not ship limit=None to the nodelets
+            q["limit"] = limit
+            q["offsets"] = offsets.get(nid)
+            calls.append((addr, "log_query", q))
+        results = self.client.call_gather(calls, timeout=timeout_s)
+        records: list[dict] = []
+        errors: dict[str, str] = {}
+        out_offsets: dict[str, dict] = {}
+        truncated = False
+        for (nid, _), r in zip(targets, results):
+            if r is None:
+                errors[nid] = ("log query failed, timed out, or node "
+                               "unreachable")
+                continue
+            for rec in r.get("records", ()):
+                rec.setdefault("node", nid)
+                records.append(rec)
+            out_offsets[nid] = r.get("offsets", {})
+            truncated = truncated or bool(r.get("truncated"))
+        records.sort(key=lambda r: r.get("ts", 0.0))
+        if len(records) > limit:
+            truncated = True
+            records = records[-limit:]
+        return {"records": records, "errors": errors,
+                "offsets": out_offsets, "truncated": truncated}
+
+    def _h_cluster_logs(self, msg, frames):
+        from ray_tpu.utils.logging import LEVELS
+
+        level = msg.get("level")
+        if level and str(level).lower() not in LEVELS:
+            # level_no() ranks unknown names as info — fine for a
+            # record, silently WIDENING as a filter; a raw-RPC caller's
+            # typo must error like the CLI/state paths do
+            raise ValueError(f"unknown level {level!r}")
+        grep = msg.get("grep")
+        if grep:
+            # same discipline: a bad regex raised inside every
+            # nodelet's log_query is indistinguishable from N dead
+            # nodes
+            import re as _re
+
+            try:
+                _re.compile(grep)
+            except _re.error as e:
+                raise ValueError(
+                    f"invalid grep regex {grep!r}: {e}") from e
+        timeout_s = max(1.0, min(float(msg.get("timeout") or 10.0),
+                                 60.0))
+        return self._gather_cluster_logs(msg, timeout_s)
+
+    def _watchtower_log_context(self, n: int = 20) -> list[dict]:
+        """Last N error-level lines cluster-wide — attached to firing
+        alerts as bounded context (runs on the watchtower thread with a
+        short budget; an unreachable node just thins the context)."""
+        r = self._gather_cluster_logs(
+            {"level": "error", "limit": n,
+             "since": time.time() - 600.0}, timeout_s=3.0)
+        return r["records"][-n:]
 
     def _h_profile_capture(self, msg, frames):
         """Cluster-wide capture: fan `profile_capture` out to every

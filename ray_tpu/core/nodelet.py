@@ -241,6 +241,10 @@ class Nodelet:
         s.register("node_cpu_stats", self._h_node_cpu_stats, slow=True)
         s.register("list_logs", self._h_list_logs)
         s.register("tail_log", self._h_tail_log)
+        # structured-log query: scans this node's JSONL log dir with
+        # filters; a big dir costs bounded tail reads, but it is still
+        # file I/O — slow lane so a log sweep never starves dispatch
+        s.register("log_query", self._h_log_query, slow=True)
         s.register("node_stats", self._h_node_stats)
         s.register("ping", lambda m, f: "pong")
 
@@ -381,6 +385,30 @@ class Nodelet:
                     "size": size}, [data]
         except OSError as e:
             return {"ok": False, "error": str(e)}
+
+    def _h_log_query(self, msg, frames):
+        """Filtered query over this node's STRUCTURED logs (the JSONL
+        files every process on this node writes via
+        utils/logging.py): tail/grep/level/time-window/trace-id/task-id
+        filters, bounded reply, per-file byte offsets for incremental
+        follow. Records are filtered to THIS node's origin by default,
+        so in-process test clusters sharing one log dir never
+        double-report a record through two nodelets."""
+        from ray_tpu.utils import logging as slog
+
+        return slog.query_log_dir(
+            self.log_dir,
+            level=msg.get("level"),
+            grep=msg.get("grep"),
+            since=msg.get("since"),
+            until=msg.get("until"),
+            trace_id=msg.get("trace_id"),
+            task=msg.get("task"),
+            proc=msg.get("proc"),
+            limit=msg.get("limit") or 1000,
+            offsets=msg.get("offsets"),
+            node=None if msg.get("any_node")
+            else self.node_id.hex()[:12])
 
     def _h_lease_demand(self, msg, frames):
         owner = msg.get("owner")
@@ -1741,6 +1769,14 @@ def main():
     nl = Nodelet(args.head_address, json.loads(args.resources),
                  labels=json.loads(args.labels), session_dir=args.session_dir,
                  store_capacity=args.store_capacity).start()
+    # structured logging for the nodelet's own process (workers install
+    # their own in worker_main; in-process test nodelets deliberately
+    # leave the host process's logging untouched)
+    from ray_tpu.utils import logging as slog
+
+    slog.install_process_logging(role="nodelet", log_dir=nl.log_dir,
+                                 node_id=nl.node_id.hex()[:12],
+                                 proc="nodelet")
     if args.address_file:
         tmp = args.address_file + ".tmp"
         with open(tmp, "w") as f:

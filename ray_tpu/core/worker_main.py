@@ -419,6 +419,11 @@ class WorkerRuntime(ClusterRuntime):
         # adopt the submitter's trace context so spans of nested submits
         # link to this task (reference: tracing_helper.py:34 propagation)
         self._ctx.trace = spec.trace
+        # log-plane attribution: structured records and captured prints
+        # from this thread tag themselves with the task; the owner
+        # address is the mirror target when RAY_TPU_LOG_TO_DRIVER is on
+        self._ctx.task_name = spec.name
+        self._ctx.task_owner = spec.owner
         t_start = time.monotonic()
         # per-task CPU attribution: thread_time deltas on the executing
         # thread feed core_task_cpu_seconds_total{kind} + the cpu_stats
@@ -464,6 +469,8 @@ class WorkerRuntime(ClusterRuntime):
             self._cpu_account(spec.name, "task",
                               time.thread_time() - t_cpu0)
             self._ctx.task_id = None
+            self._ctx.task_name = None
+            self._ctx.task_owner = None
             if notify_nodelet:
                 try:
                     self.client.send_oneway(self.nodelet_address,
@@ -588,6 +595,11 @@ class WorkerRuntime(ClusterRuntime):
             # OTHER coroutines' work to this call)
             t_cpu0 = time.thread_time()
             label = f"{type(self._actor_instance).__name__}.{mname}"
+            # log-plane attribution for this method execution (async
+            # bodies run on the shared event loop and stay unattributed
+            # — same boundary as CPU attribution's dispatch sliver)
+            self._ctx.task_name = label
+            self._ctx.task_owner = owner
             try:
                 a, kw = self._decode_args(msg["args"], msg["kwargs"])
                 fn = getattr(self._actor_instance, mname)
@@ -647,6 +659,8 @@ class WorkerRuntime(ClusterRuntime):
             finally:
                 self._cpu_account(label, "actor",
                                   time.thread_time() - t_cpu0)
+                self._ctx.task_name = None
+                self._ctx.task_owner = None
                 if inbox.empty():
                     # group inbox drained: callers are (about to be)
                     # blocked on these results — flush buffered dones
@@ -770,11 +784,30 @@ def main():
     t0 = time.monotonic()
     rt = WorkerRuntime()
     _set_runtime(rt)
+    # structured log plane: every logging call in this process lands in
+    # the node's JSONL log dir with task/trace attribution, and raw
+    # prints are captured (attributed, optionally mirrored to the
+    # submitting driver — the one-bool RAY_TPU_LOG_TO_DRIVER path)
+    from ray_tpu.core import config as cfg
+    from ray_tpu.utils import logging as slog
+
+    session_dir = os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu")
+    slog.install_process_logging(
+        role="worker",
+        log_dir=os.path.join(session_dir, "logs"),
+        node_id=os.environ.get("RAY_TPU_NODE_ID", "")[:12],
+        proc=os.environ.get("RAY_TPU_WORKER_ID", "")[:12])
+    slog.install_stream_capture(
+        mirror_fn=rt._mirror_stream_line
+        if cfg.get("LOG_TO_DRIVER") else None)
     nodelet = rt.nodelet_address
     rt.client.call(nodelet, "worker_ready",
                    {"worker_id": rt.worker_id_bytes, "address": rt.address},
                    timeout=30, retries=3)
-    print(f"[worker] ready in {time.monotonic() - t0:.3f}s", flush=True)
+    import logging as _logging
+
+    _logging.getLogger("ray_tpu.worker").info(
+        "worker ready in %.3fs", time.monotonic() - t0)
     # Stay alive while the nodelet is reachable; exit if orphaned.
     misses = 0
     while True:

@@ -34,6 +34,7 @@ _GROUPS = (
     ("serve", "Serve proxy"),
     ("rl", "RL flywheel"),
     ("profile", "Profiler plane"),
+    ("log", "Logs"),
     ("spans", "Span plane"),
     ("watchtower", "Alerts"),
 )

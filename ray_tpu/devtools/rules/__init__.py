@@ -6,6 +6,7 @@ Adding a rule: create a module here, subclass ``Rule``, decorate with
 
 from ray_tpu.devtools.rules import (  # noqa: F401
     async_blocking,
+    bare_print,
     blocking_lock,
     discarded_future,
     except_hygiene,

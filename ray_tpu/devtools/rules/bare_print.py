@@ -1,0 +1,70 @@
+"""GL016: bare ``print()`` / raw stderr writes in package code.
+
+The structured log plane (``ray_tpu/utils/logging.py``) gives every
+process bounded JSONL records with node/proc/task/trace attribution —
+queryable via ``ray_tpu logs``, counted into ``log_records_total``,
+watched by the error-rate rule. A bare ``print()`` in library code
+bypasses all of it: on a worker the line lands attributed only because
+the stream CAPTURE rescues it (and then with no level or logger name);
+on the head/nodelet/driver it goes straight to a console nobody tails.
+Package code logs through ``logging.getLogger(...)``.
+
+Scope: fires on ``print(...)`` calls and on ``sys.stdout.write`` /
+``sys.stderr.write`` calls. CLI/devtools entry points are exempt by
+path (``ray_tpu/scripts/``, ``ray_tpu/devtools/`` — their stdout IS
+the user interface), as are bench drivers (outside the package).
+Deliberate raw-console sites — protocol handshakes parsed from stdout,
+the driver-side mirror endpoint whose purpose is the console — carry
+justified suppressions."""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools.context import ModuleContext, qualname
+from ray_tpu.devtools.registry import Rule, register
+
+_EXEMPT_PARTS = ("scripts/", "devtools/")
+_STREAM_WRITES = {"sys.stdout.write", "sys.stderr.write"}
+
+
+@register
+class BarePrintRule(Rule):
+    name = "bare-print"
+    code = "GL016"
+    description = ("bare print()/sys.std{out,err}.write in package "
+                   "code bypasses the structured log plane — use "
+                   "logging.getLogger(...)")
+    invariant = ("library code emits through the structured logger "
+                 "(attributed, counted, queryable); raw console "
+                 "writes belong to CLI entry points and sanctioned "
+                 "protocol/mirror sites only")
+    interests = ("Call",)
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        rel = ctx.rel_path
+        self._exempt = any(
+            rel.startswith(part) or f"/{part}" in rel
+            for part in _EXEMPT_PARTS)
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if self._exempt:
+            return
+        func = node.func
+        if isinstance(func, ast.Name) and func.id == "print":
+            ctx.report(self, node,
+                       "bare print() in package code — raw console "
+                       "output bypasses the structured log plane "
+                       "(no level, no task/trace attribution, not "
+                       "queryable via `ray_tpu logs`); use "
+                       "logging.getLogger(...)")
+            return
+        qn = qualname(func)
+        if qn is None:
+            return
+        if ctx.resolve(qn) in _STREAM_WRITES:
+            ctx.report(self, node,
+                       f"raw {ctx.resolve(qn)}() in package code — "
+                       "bypasses the structured log plane; use "
+                       "logging.getLogger(...) (or a sanctioned "
+                       "suppression for protocol/console sites)")

@@ -12,6 +12,8 @@ Reference parity: python/ray/scripts/scripts.py — `ray start --head`,
   python -m ray_tpu.scripts.cli metrics  --address HOST:PORT
   python -m ray_tpu.scripts.cli alerts   --address HOST:PORT [--json]
   python -m ray_tpu.scripts.cli profile  --address HOST:PORT [-d SECS]
+  python -m ray_tpu.scripts.cli logs     --address HOST:PORT [--follow]
+      [--grep RE] [--level error] [--node N] [--task TID] [--trace-id T]
   python -m ray_tpu.scripts.cli debug-dump --address HOST:PORT [-o DIR]
   python -m ray_tpu.scripts.cli stop   [--session-dir DIR]
 """
@@ -76,6 +78,15 @@ def cmd_start(args):
     nodelet = Nodelet(head_address, res,
                       labels=json.loads(args.labels or "{}"),
                       session_dir=session_dir).start()
+    # this process (head+nodelet or nodelet) joins the structured log
+    # plane too, so control-plane warnings are queryable via
+    # `ray_tpu logs` like any worker's
+    from ray_tpu.utils import logging as slog
+
+    slog.install_process_logging(
+        role="head" if args.head else "nodelet",
+        log_dir=nodelet.log_dir,
+        node_id=nodelet.node_id.hex()[:12], proc="nodelet")
     print(f"nodelet started at {nodelet.address} with {res}")
     if getattr(args, "node_info_file", None):
         # machine-readable handle for the cluster launcher / autoscaler
@@ -248,18 +259,119 @@ def cmd_debug_dump(args):
 
 
 def cmd_logs(args):
-    """Stream node logs (reference: `ray logs` over the log monitor,
-    _private/log_monitor.py:103)."""
+    """Cluster logs (reference: `ray logs` over the log monitor,
+    _private/log_monitor.py:103 — here structured-first). Default mode
+    queries the STRUCTURED log plane cluster-wide with
+    grep/level/node/task/trace filters and supports `--follow`
+    (incremental, offset-cursored). Legacy raw-file mode remains:
+    `ray_tpu logs NODE [FILE] --address ...` lists/tails one node's
+    raw log files byte-for-byte."""
     from ray_tpu.util import state
+    from ray_tpu.utils.logging import format_record
 
-    if args.file is None:
-        print(json.dumps(state.list_logs(args.node, address=args.address),
-                         indent=2))
+    if args.node_or_file:
+        # legacy raw-file mode
+        if args.file is None:
+            print(json.dumps(
+                state.list_logs(args.node_or_file, address=args.address),
+                indent=2))
+            return 0
+        text, _ = state.tail_log(args.node_or_file, args.file,
+                                 nbytes=args.nbytes,
+                                 address=args.address)
+        sys.stdout.write(text)
         return 0
-    text, _ = state.tail_log(args.node, args.file, nbytes=args.nbytes,
-                             address=args.address)
-    sys.stdout.write(text)
-    return 0
+
+    def query(offsets=None, limit=None, window_s=None):
+        return state.cluster_logs(
+            address=args.address, level=args.level, grep=args.grep,
+            node=args.node, task=args.task, trace_id=args.trace_id,
+            proc=args.proc, limit=limit or args.tail,
+            window_s=window_s, offsets=offsets,
+            timeout=args.rpc_timeout)
+
+    def show(reply, following=False):
+        for rec in reply["records"]:
+            print(json.dumps(rec, default=str) if args.json
+                  else format_record(rec))
+        if reply.get("truncated"):
+            # never a silent gap: the reply cap dropped older records
+            hint = ("burst exceeded the per-poll cap, older records "
+                    "in the gap were skipped — narrow with "
+                    "--grep/--level" if following else
+                    "more matching records than the reply cap — "
+                    "narrow with --grep/--level/--window or raise "
+                    "--tail")
+            print(f"  ... truncated: {hint}", file=sys.stderr)
+
+    follow_since = time.monotonic()
+    try:
+        r = query(window_s=args.window)
+    except ValueError as e:  # e.g. an invalid --grep regex
+        print(f"logs: {e}", file=sys.stderr)
+        return 2
+    show(r)
+    for nid, err in sorted(r.get("errors", {}).items()):
+        print(f"  MISSING node {nid}: {err}", file=sys.stderr)
+    if not args.follow:
+        return 0
+    # follow: pass each reply's offsets back so only NEW records ship.
+    # A dead head ends the follow CLEANLY (note + exit 0): tailing a
+    # cluster through its shutdown is the normal way this loop ends.
+    offsets = dict(r.get("offsets") or {})
+    drain = False
+    misses = 0
+    last_missing = set(r.get("errors") or {})
+    try:
+        while True:
+            if not drain:
+                time.sleep(args.poll)
+            try:
+                # per-poll limit pinned at the reply cap (a follow
+                # wants everything new, not the one-shot's --tail
+                # view) and time-bounded to the follow itself: a node
+                # recovering mid-follow has no cursor yet, and its
+                # fresh tail scan must not re-dump pre-follow history
+                # into the stream
+                r = query(offsets=offsets, limit=5000,
+                          window_s=time.monotonic() - follow_since)
+                misses = 0
+            except Exception as e:  # noqa: BLE001
+                # a busy head can miss one poll budget mid-incident —
+                # exactly when someone is tailing; only consecutive
+                # misses mean the head is actually gone
+                misses += 1
+                if misses < 3:
+                    drain = False
+                    continue
+                print(f"log follow ended: head unreachable ({e})",
+                      file=sys.stderr)
+                return 0
+            # merge PER FILE: a node that errored this round (absent
+            # from the reply) keeps its cursors, and a file a nodelet
+            # skipped on a transient read error keeps its cursor too —
+            # replacing wholesale would rescan tails and re-print
+            # already-shown records next poll
+            for nid, cur in (r.get("offsets") or {}).items():
+                merged = dict(offsets.get(nid) or {})
+                merged.update(cur or {})
+                offsets[nid] = merged
+            show(r, following=True)
+            # per-node errors surface on TRANSITION (noting a dead
+            # node once beats repeating it every poll — and a quiet
+            # tail must never mean "that node had nothing to say")
+            missing = set(r.get("errors") or {})
+            for nid in sorted(missing - last_missing):
+                print(f"  MISSING node {nid}: {r['errors'][nid]}",
+                      file=sys.stderr)
+            for nid in sorted(last_missing - missing):
+                print(f"  node {nid} answering again", file=sys.stderr)
+            last_missing = missing
+            # a truncated poll means a burst is in flight: poll again
+            # immediately to drain instead of sleeping into more loss
+            drain = bool(r.get("truncated"))
+    except KeyboardInterrupt:  # graftlint: disable=except-hygiene
+        return 0  # ^C IS how an operator ends a follow
 
 
 def cmd_stop(args):
@@ -402,11 +514,41 @@ def main(argv=None):
                    help="total wall-time budget in seconds")
     p.set_defaults(fn=cmd_debug_dump)
 
-    p = sub.add_parser("logs")
-    p.add_argument("node", help="node id (hex prefix)")
-    p.add_argument("file", nargs="?", help="log file name (omit to list)")
+    p = sub.add_parser("logs",
+                       help="search/follow structured cluster logs; "
+                            "NODE [FILE] = legacy raw-file mode")
+    p.add_argument("node_or_file", nargs="?",
+                   help="node id hex prefix (raw-file mode; omit for "
+                        "the structured query)")
+    p.add_argument("file", nargs="?", help="raw log file name "
+                                           "(omit to list)")
     p.add_argument("--address", required=True)
     p.add_argument("--nbytes", type=int, default=64 * 1024)
+    p.add_argument("--grep", help="regex over msg/logger")
+    p.add_argument("--level",
+                   choices=["debug", "info", "warning", "error",
+                            "critical"],
+                   help="minimum level (a typo must not silently "
+                        "widen the filter to info-and-up)")
+    p.add_argument("--node", help="node id hex prefix filter")
+    p.add_argument("--task", help="task id (hex) filter")
+    p.add_argument("--trace-id", dest="trace_id",
+                   help="trace id filter (correlates with the merged "
+                        "timeline)")
+    p.add_argument("--proc", help="worker id (hex12) filter")
+    p.add_argument("--tail", type=int, default=100,
+                   help="records to show (most recent; default 100)")
+    p.add_argument("--window", type=float, default=None,
+                   help="trailing window in seconds")
+    p.add_argument("-f", "--follow", action="store_true",
+                   help="keep streaming new records (exits cleanly "
+                        "when the head goes away)")
+    p.add_argument("--poll", type=float, default=1.0,
+                   help="follow poll interval in seconds")
+    p.add_argument("--rpc-timeout", type=float, default=5.0,
+                   help="per-query RPC budget")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSONL records instead of formatted lines")
     p.set_defaults(fn=cmd_logs)
 
     p = sub.add_parser("stop")

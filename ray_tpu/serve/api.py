@@ -1838,10 +1838,11 @@ class ProxyActor:
                     proxy._requests.inc(
                         tags={"app": app, "status": str(status)})
                     proxy._latency.observe(ms, tags={"app": app})
-                    # access log → worker log file
-                    print(f"[serve-proxy] {self.client_address[0]} "
-                          f"POST /{app} {status} {ms:.1f}ms"
-                          f"{' stream' if stream else ''}", flush=True)
+                    # access log → structured log plane (replica
+                    # processes install the JSONL handler)
+                    _log.info("[serve-proxy] %s POST /%s %d %.1fms%s",
+                              self.client_address[0], app, status, ms,
+                              " stream" if stream else "")
 
             def _do_stream(self, app: str, payload) -> int:
                 """NDJSON chunked response: one line per yielded chunk,

@@ -186,6 +186,18 @@ CATALOG: list[dict] = [
     {"name": "profile_stacks_dropped_total", "type": "counter",
      "where": "ray_tpu/util/profiler.py",
      "what": "thread-samples rejected by the unique-stack cap"},
+    # log plane
+    {"name": "log_records_total", "type": "counter",
+     "where": "ray_tpu/utils/logging.py",
+     "what": "structured log records emitted, by level (the "
+             "error-rate-spike rule's input)"},
+    {"name": "log_bytes_total", "type": "counter",
+     "where": "ray_tpu/utils/logging.py",
+     "what": "structured JSONL log bytes written"},
+    {"name": "log_records_dropped_total", "type": "counter",
+     "where": "ray_tpu/utils/logging.py",
+     "what": "log records lost to serialization/disk failure "
+             "(drops counted, never silent)"},
     # span plane
     {"name": "spans_sampled_total", "type": "counter",
      "where": "ray_tpu/utils/events.py",

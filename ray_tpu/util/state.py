@@ -232,6 +232,58 @@ def tail_log(node_id: str, file: str, nbytes: int = 64 * 1024,
     return frames[0].decode(errors="replace"), value["end_offset"]
 
 
+def cluster_logs(address: str | None = None, *, level: str | None = None,
+                 grep: str | None = None, node: str | None = None,
+                 task: str | None = None, trace_id: str | None = None,
+                 proc: str | None = None, limit: int = 1000,
+                 window_s: float | None = None,
+                 offsets: dict | None = None,
+                 timeout: float = 15) -> dict:
+    """Cluster-wide structured-log query (the fourth observability
+    plane): the head fans `log_query` out to every alive nodelet under
+    ONE shared deadline and returns the merged, ts-sorted records —
+    ``{"records": [...], "errors": {node12: why}, "offsets": {node12:
+    {file: cursor}}, "truncated"}``. Cursors are OPAQUE round-trip
+    values (currently ``[inode, byte]`` — rotation is detected by file
+    identity); pass them back verbatim, never construct them. A
+    stopped node costs at most the shared deadline and lands in
+    ``errors``; it never fails the query.
+
+    Filters: ``level`` is a minimum severity, ``grep`` a regex over
+    msg/logger, ``node`` a node-id hex prefix, ``task``/``trace_id``
+    exact ids (the correlation keys every record carries — see
+    OBSERVABILITY.md "Logging"), ``window_s`` a trailing wall-clock
+    window. Pass a reply's ``offsets`` back in to read only new
+    records (the `--follow` primitive)."""
+    import time as _time
+
+    if grep:
+        # validate HERE: a bad regex raised inside every nodelet's
+        # log_query is indistinguishable from N dead nodes
+        import re as _re
+
+        try:
+            _re.compile(grep)
+        except _re.error as e:
+            raise ValueError(f"invalid grep regex {grep!r}: {e}") from e
+    from ray_tpu.utils.logging import LEVELS
+
+    if level and str(level).lower() not in LEVELS:
+        # level_no() ranks unknown names as info — fine for ranking a
+        # record, silently wrong as a FILTER ("warn" must not widen
+        # the view to info-and-up)
+        raise ValueError(f"unknown level {level!r}; one of "
+                         f"{sorted(LEVELS)}")
+    msg: dict = {"level": level, "grep": grep, "node": node,
+                 "task": task, "trace_id": trace_id, "proc": proc,
+                 "limit": limit, "offsets": offsets,
+                 "timeout": timeout}
+    if window_s is not None:
+        msg["since"] = _time.time() - float(window_s)
+    return _head_call("cluster_logs", msg, address=address,
+                      timeout=timeout + 5)
+
+
 def list_placement_groups(address: str | None = None,
                           timeout: float = 30) -> list[dict]:
     return _head_call("pg_table", address=address,
@@ -587,6 +639,20 @@ def debug_dump(out_dir: str | None = None, address: str | None = None,
          lambda: cluster_timeline(
              address, os.path.join(out_dir, "timeline.json"),
              timeout=budget()))
+
+    # incident-window structured logs: the last ~10min of records at
+    # warning-and-up, cluster-wide and trace/task-tagged — the filtered
+    # view an incident writeup greps FIRST (the raw per-node tails
+    # below stay for everything the structured plane did not capture)
+    def _cluster_logs():
+        r = cluster_logs(address, level="warning", window_s=600.0,
+                         limit=2000, timeout=budget())
+        lines = [json.dumps(rec, default=str) for rec in r["records"]]
+        for nid, err in r.get("errors", {}).items():
+            summary["errors"][f"cluster_logs:{nid}"] = err
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    step("cluster_logs", _cluster_logs, twrite("logs.jsonl"))
 
     # short cluster profile: where every process's threads were while
     # the incident was live (the alert-triggered autodump path rides

@@ -449,6 +449,17 @@ def default_rules() -> list[WatchRule]:
                         "2min after prior activity — a hung gang "
                         "(deadlocked collective, dead worker)"),
         WatchRule(
+            "log-error-spike", metric="log_records_total",
+            kind="rate", agg="sum", labels={"level": "error"},
+            op=">", threshold=float(os.environ.get(
+                "RAY_TPU_WATCHTOWER_LOG_ERRORS_PER_S", "5.0")),
+            window_s=30, for_s=10, severity="warning",
+            description="error-level log records faster than "
+                        "RAY_TPU_WATCHTOWER_LOG_ERRORS_PER_S (default "
+                        "5/s) sustained — something is failing "
+                        "repeatedly; the firing alert carries the last "
+                        "error lines as context"),
+        WatchRule(
             "object-stranded-refs",
             metric="object_store_stranded_bytes",
             stat="last", agg="sum", op=">",
@@ -481,7 +492,7 @@ class Alert:
 
     __slots__ = ("rule", "severity", "state", "fingerprint", "value",
                  "threshold", "since", "firing_since", "resolved_at",
-                 "description")
+                 "description", "context")
 
     def __init__(self, rule: WatchRule, value: float, now_wall: float):
         self.rule = rule.name
@@ -494,14 +505,21 @@ class Alert:
         self.firing_since: float | None = None
         self.resolved_at: float | None = None
         self.description = rule.description
+        # last-N error-level log lines attached at the firing
+        # transition (bounded; None until/unless the alert fires with a
+        # log_context_fn wired)
+        self.context: list[dict] | None = None
 
     def to_dict(self) -> dict:
-        return {"rule": self.rule, "severity": self.severity,
-                "state": self.state, "fingerprint": self.fingerprint,
-                "value": self.value, "threshold": self.threshold,
-                "since": self.since, "firing_since": self.firing_since,
-                "resolved_at": self.resolved_at,
-                "description": self.description}
+        out = {"rule": self.rule, "severity": self.severity,
+               "state": self.state, "fingerprint": self.fingerprint,
+               "value": self.value, "threshold": self.threshold,
+               "since": self.since, "firing_since": self.firing_since,
+               "resolved_at": self.resolved_at,
+               "description": self.description}
+        if self.context is not None:
+            out["context"] = self.context
+        return out
 
 
 def alert_fingerprint(rule: WatchRule) -> str:
@@ -530,11 +548,17 @@ class Watchtower:
                  autodump_cooldown_s: float | None = None,
                  address_fn=None, span_sink=None, dump_fn=None,
                  history_limit: int = 200,
-                 series_ttl_s: float | None = None):
+                 series_ttl_s: float | None = None,
+                 log_context_fn=None, log_context_n: int = 20):
         self._scrape = scrape
         self._address_fn = address_fn
         self._span_sink = span_sink
         self._dump_fn = dump_fn
+        # log_context_fn(n) -> last n error-level log records; attached
+        # to alerts at their firing transition (fetched OUTSIDE the
+        # lock — it is an RPC fan-out on the head)
+        self._log_context_fn = log_context_fn
+        self._log_context_n = log_context_n
         if period_s is None:
             period_s = float(os.environ.get(
                 "RAY_TPU_WATCHTOWER_PERIOD_S", "5.0"))
@@ -605,19 +629,32 @@ class Watchtower:
             now = time.monotonic()
         samples = parse_prometheus(text)
         dump_requests: list[str] = []
+        fired: list[Alert] = []
         with self._lock:
             self.history.append(now, samples)
             self.history.prune(now - self.series_ttl_s)
             self._samples_total += 1
-            self._evaluate_locked(now, dump_requests)
+            self._evaluate_locked(now, dump_requests, fired)
             self._publish_metrics_locked()
+        if fired and self._log_context_fn is not None:
+            # attach the last error-level log lines as bounded context.
+            # Fetched OUTSIDE the lock (it is an RPC fan-out); a failed
+            # fetch just leaves the alert context-less.
+            try:
+                context = self._log_context_fn(self._log_context_n)
+            except Exception:  # noqa: BLE001
+                context = None
+            if context:
+                with self._lock:
+                    for alert in fired:
+                        alert.context = context[-self._log_context_n:]
         for rule_name in dump_requests:
             self._spawn_autodump(rule_name)
 
     # ----------------------------------------------------------- evaluation
 
-    def _evaluate_locked(self, now: float, dump_requests: list[str]
-                         ) -> None:
+    def _evaluate_locked(self, now: float, dump_requests: list[str],
+                         fired: list["Alert"] | None = None) -> None:
         now_wall = now + self._anchor
         for rule in self.rules:
             try:
@@ -638,6 +675,8 @@ class Watchtower:
                         now_wall - alert.since >= rule.for_s:
                     alert.state = AlertState.FIRING
                     alert.firing_since = now_wall
+                    if fired is not None:
+                        fired.append(alert)
                     self._transition_locked(alert, AlertState.PENDING,
                                             AlertState.FIRING, now)
                     if rule.severity == "critical" and \

@@ -1,0 +1,16 @@
+"""GL016 firing fixture: raw console output in package code."""
+
+import sys
+from sys import stderr
+
+
+def announce(value):
+    print(f"computed {value}")  # FIRE: bare print in library code
+
+
+def warn_raw(msg):
+    sys.stderr.write(f"warning: {msg}\n")  # FIRE: raw stderr write
+
+
+def warn_aliased(msg):
+    stderr.write(f"warning: {msg}\n")  # FIRE: aliased stderr write

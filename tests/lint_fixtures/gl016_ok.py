@@ -1,0 +1,33 @@
+"""GL016 clean fixture: structured logging and non-console writes."""
+
+import logging
+
+_log = logging.getLogger("ray_tpu.fixture")
+
+
+def announce(value):
+    _log.info("computed %s", value)  # the sanctioned path
+
+
+def warn(msg):
+    _log.warning("warning: %s", msg)
+
+
+def persist(path, data):
+    with open(path, "w") as f:
+        f.write(data)  # a file's write is not a console write
+
+
+class Sink:
+    def write(self, chunk):  # defining write is fine
+        return len(chunk)
+
+
+def drain(sink: Sink, chunk):
+    sink.write(chunk)  # and so is calling a non-sys stream's write
+
+
+def sanctioned_handshake(address):
+    # protocol output a parent process parses from stdout — the
+    # justified-suppression shape
+    print(f"ADDR {address}", flush=True)  # graftlint: disable=bare-print

@@ -329,33 +329,70 @@ def test_chaos_mid_chain_death_falls_back_cleanly(ray_boot):
         f"stranded oids: {len(rt._owned)} vs {owned_before}"
 
 
+def _actor_state(handle):
+    """Head's view of an actor: (state, address)."""
+    from ray_tpu.core.api import _global_runtime
+
+    rt = _global_runtime()
+    r = rt.client.call(rt.head_address, "get_actor",
+                       {"actor_id": handle._actor_id.binary(),
+                        "wait": False}, timeout=10)
+    return r.get("state"), r.get("address")
+
+
+def _await_actor_settled(handle, old_address, deadline_s=120.0):
+    """Deterministic post-heal settle barrier (the ROADMAP-noted
+    module-context-load flake: the old wait loop only proved ONE eager
+    call landed, which can race the heal while the head still
+    publishes the dying incarnation's address — the DAG's fallback
+    probe then sees ALIVE at the OLD address and keeps polling its
+    dead channels). Event-gate on the actual replay preconditions:
+    the head reports the actor ALIVE at a NEW address, AND an eager
+    call through the handle completes against that incarnation."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            state, address = _actor_state(handle)
+        except Exception:  # noqa: BLE001  # head briefly busy under load
+            time.sleep(0.2)
+            continue
+        if state == "ALIVE" and address and address != old_address:
+            try:
+                ray_tpu.get(handle.step.remote(0), timeout=30)
+                return address
+            except RayTpuError:
+                pass  # replacement not serving yet (at-most-once race)
+        time.sleep(0.2)
+    raise TimeoutError("actor did not settle at a new incarnation "
+                       f"within {deadline_s}s")
+
+
 def test_chaos_restartable_actor_replays_through_fallback(ray_boot):
     """A restartable mid-chain actor: the heal plane republishes its
     routing and the eager fallback REPLAYS retained inputs through the
-    restarted incarnation — executions complete with correct values."""
+    restarted incarnation — executions complete with correct values.
+    Post-heal execution is gated on `_await_actor_settled` (ALIVE at a
+    NEW address + a served eager call) and the replay window is wide:
+    under module-context load the respawn alone can take tens of
+    seconds, and the old one-successful-call wait raced the routing
+    republish."""
     a = Stage.remote(1)
     b = Stage.options(max_restarts=1).remote(10)
     c = Stage.remote(100)
     ray_tpu.get([a.step.remote(0), b.step.remote(0), c.step.remote(0)])
+    _, b_addr0 = _actor_state(b)
     dag = _chain_dag([a, b, c]).compile()
     try:
         assert dag.execute(0).get() == 111
         ray_tpu.kill(b, no_restart=False)
-        # wait until the replacement incarnation serves eager calls (the
-        # at-most-once actor-call contract makes a submit racing the
-        # death lose — same as any eager caller's)
-        deadline = time.monotonic() + 60
-        while time.monotonic() < deadline:
-            try:
-                ray_tpu.get(b.step.remote(0), timeout=30)
-                break
-            except RayTpuError:
-                time.sleep(0.2)
+        # settle barrier: the replacement incarnation is published AND
+        # serving before the DAG replays through it
+        _await_actor_settled(b, b_addr0)
         refs = [dag.execute(i) for i in range(3)]
         # the fallback resolves the restarted incarnation (stages are
         # stateless, so replay values match the compiled path exactly)
-        assert [r.get(timeout=60) for r in refs] == [111 + i for i in
-                                                    range(3)]
+        assert [r.get(timeout=120) for r in refs] == [111 + i for i in
+                                                     range(3)]
         assert dag._broken
     finally:
         dag.teardown()

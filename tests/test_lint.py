@@ -44,6 +44,7 @@ RULE_CASES = [
     ("GL013", "handler-reentry", "gl013_fire.py", "gl013_ok.py", 3),
     ("GL014", "sequential-rpc-in-loop", "gl014_fire.py", "gl014_ok.py", 3),
     ("GL015", "wallclock-duration", "gl015_fire.py", "gl015_ok.py", 3),
+    ("GL016", "bare-print", "gl016_fire.py", "gl016_ok.py", 3),
 ]
 
 
@@ -66,7 +67,7 @@ def test_rule_catalog_complete():
     assert [c.code for c in catalog] == [
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
         "GL008", "GL009", "GL010", "GL011", "GL012", "GL013", "GL014",
-        "GL015"]
+        "GL015", "GL016"]
     for cls in catalog:
         assert cls.name and cls.description and cls.invariant
 
