@@ -130,6 +130,95 @@ def pipelined_loss(params, batch, cfg: PipelinedConfig, mesh,
     return jnp.mean(nll)
 
 
+# ---------------------------------------------------------------------------
+# MPMD stage split — the 1F1B worker-group strategy's model face
+# ---------------------------------------------------------------------------
+
+
+def split_pipeline_stages(params, cfg: PipelinedConfig,
+                          num_stages: int) -> list[dict]:
+    """Split a full pipelined-param tree into `num_stages` contiguous
+    stage subtrees for the MPMD strategy (train/pipeline_strategy.py):
+    stage s gets blocks[V*s//S : V*(s+1)//S]; stage 0 additionally owns
+    embed/pos, the last stage ln_f/head. Union of stages == the full
+    tree, so a single-program run of the same params is the parity
+    reference."""
+    V, S = cfg.n_virtual_stages, num_stages
+    if not 1 <= S <= V:
+        raise ValueError(f"need 1 <= stages <= {V} blocks, got {S}")
+    stages = []
+    for s in range(S):
+        lo, hi = V * s // S, V * (s + 1) // S
+        stage = {"blocks": jax.tree.map(lambda p: p[lo:hi],
+                                        params["blocks"])}
+        if s == 0:
+            stage["embed"], stage["pos"] = params["embed"], params["pos"]
+        if s == S - 1:
+            stage["ln_f"], stage["head"] = params["ln_f"], params["head"]
+        stages.append(stage)
+    return stages
+
+
+def merge_pipeline_stages(stages: list[dict]) -> dict:
+    """Inverse of `split_pipeline_stages` (checkpointing / parity)."""
+    blocks = jax.tree.map(
+        lambda *leaves: jnp.concatenate(leaves, axis=0),
+        *[st["blocks"] for st in stages])
+    return {"embed": stages[0]["embed"], "pos": stages[0]["pos"],
+            "blocks": blocks, "ln_f": stages[-1]["ln_f"],
+            "head": stages[-1]["head"]}
+
+
+def _local_mesh():
+    """One-device mesh carrying the `fsdp` axis so `_block`'s ring
+    attention resolves outside the hybrid-mesh program (size-1 ring ==
+    plain causal attention, numerically the same blockwise softmax)."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:1]), ("fsdp",))
+
+
+def stage_apply(cfg: PipelinedConfig, stage_params: dict, stage_idx: int,
+                num_stages: int, payload, targets=None, mesh=None):
+    """One pipeline stage's forward: tokens -> h for stage 0, h -> h in
+    the middle, h -> scalar loss (or logits when `targets` is None) on
+    the last stage. Runs the SAME `_block` math as `pipelined_loss`
+    (under a size-1 fsdp shard_map), so chaining all stages reproduces
+    the single-program loss bit-for-bit modulo float reassociation.
+    Differentiable — the MPMD strategy takes jax.vjp of this per
+    microbatch."""
+    from ray_tpu.parallel.ops import shard_map as _shard_map
+    from jax.sharding import PartitionSpec as P
+
+    first, last = stage_idx == 0, stage_idx == num_stages - 1
+    if first:
+        tokens = payload
+        h = stage_params["embed"][tokens] \
+            + stage_params["pos"][None, :tokens.shape[1]]
+    else:
+        h = payload
+    mesh = mesh if mesh is not None else _local_mesh()
+
+    def body(blocks, hh):
+        def one(carry, blk):
+            return _block(cfg, blk, carry), None
+
+        out, _ = jax.lax.scan(one, hh, blocks)
+        return out
+
+    h = _shard_map(body, mesh, in_specs=(P(), P()), out_specs=P())(
+        stage_params["blocks"], h)
+    if not last:
+        return h
+    logits = _rms(h * stage_params["ln_f"]) @ stage_params["head"]
+    if targets is None:
+        return logits
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
 def pipelined_shardings(params, cfg: PipelinedConfig, mesh):
     """NamedShardings: block stacks over pipe (+ tensor on the wide
     dim), embed/head over tensor, rest replicated."""

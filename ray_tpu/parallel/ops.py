@@ -77,6 +77,38 @@ def axis_size(axis_name: str):
     return jax.lax.psum(1, axis_name)
 
 
+_HLO_COLLECTIVES = {
+    "all-reduce": "allreduce",
+    "all-gather": "all_gather",
+    "reduce-scatter": "reduce_scatter",
+    "collective-permute": "collective_permute",
+    "all-to-all": "all_to_all",
+}
+
+
+def collective_op_counts(hlo_text: str) -> dict[str, int]:
+    """Count the collective ops in a compiled HLO module, keyed by the
+    catalog's `op=` label names (allreduce/all_gather/reduce_scatter/
+    collective_permute/all_to_all).
+
+    This is the structural face of collective attribution: in-program
+    collectives cannot be wall-timed from the host (XLA fuses and
+    overlaps them), but the compiled program says exactly which ones a
+    step pays for — e.g. a ZeRO-1 step trades the grad allreduce for
+    reduce-scatter + param all-gather (on XLA:CPU the partitioner keeps
+    allreduce + slice and the param all-gathers appear; on TPU it forms
+    true reduce-scatter). Async pairs (`*-start`/`*-done`) count once.
+    """
+    import re
+
+    out: dict[str, int] = {}
+    for hlo_name, label in _HLO_COLLECTIVES.items():
+        n = len(re.findall(rf"{hlo_name}(?:-start)?\(", hlo_text))
+        if n:
+            out[label] = n
+    return out
+
+
 def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
     """`jax.shard_map` with varying-manual-axes checking off by default:
     collective-heavy SPMD bodies (all_gather outputs, ring schedules)

@@ -1,5 +1,5 @@
-"""Pipeline parallelism — GPipe-style microbatch schedule over the
-`pipe` mesh axis.
+"""Pipeline parallelism — microbatch schedules over the `pipe` mesh
+axis (in-program) and over worker groups (MPMD, 1F1B).
 
 SURVEY.md §7.8: PP is a first-class capability (the reference schedules
 frameworks that implement it; here it is native). TPU-native design:
@@ -24,6 +24,119 @@ import jax.numpy as jnp
 from jax import lax
 
 from ray_tpu.parallel.ops import axis_size as _axis_size
+
+
+# ---------------------------------------------------------------------------
+# 1F1B (MPMD) schedule — the worker-group strategy's timetable
+# ---------------------------------------------------------------------------
+#
+# The in-program schedules below run every stage on every device inside
+# one SPMD program. The MPMD alternative ("Scaling Deep Learning
+# Training with MPMD Pipeline Parallelism") gives each STAGE its own
+# worker process and streams activations between them; the classic
+# one-forward-one-backward (1F1B) order keeps at most (S - s) live
+# activations on stage s while reaching the same (S-1)/(S-1+M) bubble
+# as GPipe. These helpers are pure schedule math — data, not lax — so
+# the driver (train/pipeline_strategy.py) can submit actor calls in
+# exactly this order and a unit test can pin the interleave.
+
+
+def one_f_one_b_schedule(num_stages: int, num_microbatches: int
+                         ) -> list[list[tuple[str, int]]]:
+    """Per-stage 1F1B op order: result[s] is the exact sequence of
+    ("fwd"|"bwd", microbatch) ops stage s executes. Stage s warms up
+    with min(M, S-1-s) forwards, alternates fwd/bwd through the steady
+    state, then drains the remaining backwards — the Megatron
+    schedules.py order, as a list."""
+    S, M = num_stages, num_microbatches
+    if S < 1 or M < 1:
+        raise ValueError(f"need stages >= 1 and microbatches >= 1, "
+                         f"got {S}, {M}")
+    sched: list[list[tuple[str, int]]] = []
+    for s in range(S):
+        warm = min(M, S - 1 - s)
+        ops = [("fwd", m) for m in range(warm)]
+        for i in range(M - warm):
+            ops.append(("fwd", warm + i))
+            ops.append(("bwd", i))
+        for m in range(M - warm, M):
+            ops.append(("bwd", m))
+        sched.append(ops)
+    return sched
+
+
+def one_f_one_b_submission_order(num_stages: int, num_microbatches: int
+                                 ) -> list[tuple[str, int, int]]:
+    """Global topological submission order for the 1F1B schedule:
+    (kind, stage, microbatch) triples such that every op appears after
+    its dependencies — fwd(s,m) after fwd(s-1,m); bwd(s,m) after
+    fwd(s,m) and bwd(s+1,m) — while each stage's own ops appear in its
+    `one_f_one_b_schedule` order. A driver submitting actor calls in
+    this order can wire every call's inputs to already-created object
+    refs, and per-actor FIFO execution then IS the 1F1B interleave."""
+    S, M = num_stages, num_microbatches
+    per_stage = one_f_one_b_schedule(S, M)
+    ptr = [0] * S
+    emitted: set[tuple[str, int, int]] = set()
+    order: list[tuple[str, int, int]] = []
+    remaining = sum(len(ops) for ops in per_stage)
+    while len(order) < remaining:
+        progressed = False
+        for s in range(S):
+            while ptr[s] < len(per_stage[s]):
+                kind, m = per_stage[s][ptr[s]]
+                deps = []
+                if kind == "fwd" and s > 0:
+                    deps.append(("fwd", s - 1, m))
+                if kind == "bwd":
+                    deps.append(("fwd", s, m))
+                    if s < S - 1:
+                        deps.append(("bwd", s + 1, m))
+                if not all(d in emitted for d in deps):
+                    break
+                op = (kind, s, m)
+                order.append(op)
+                emitted.add(op)
+                ptr[s] += 1
+                progressed = True
+        if not progressed:
+            raise RuntimeError(  # unreachable: 1F1B is deadlock-free
+                f"1F1B submission stalled at {ptr} for S={S} M={M}")
+    return order
+
+
+def simulate_1f1b(num_stages: int, num_microbatches: int,
+                  fwd_ticks: float = 1.0, bwd_ticks: float = 1.0) -> dict:
+    """Discrete-event simulation of the 1F1B schedule with fixed op
+    costs: returns {"makespan", "busy", "bubble_ratio"} where
+    bubble_ratio = 1 - busy / (S * makespan). With fwd == bwd cost this
+    reproduces the textbook (S-1)/(S-1+M) bubble exactly — the
+    theoretical floor the strategy's measured bubble is compared to."""
+    S, M = num_stages, num_microbatches
+    per_stage = one_f_one_b_schedule(S, M)
+    cost = {"fwd": fwd_ticks, "bwd": bwd_ticks}
+    done: dict[tuple[str, int, int], float] = {}
+    free = [0.0] * S
+    for kind, s, m in one_f_one_b_submission_order(S, M):
+        deps = []
+        if kind == "fwd" and s > 0:
+            deps.append(("fwd", s - 1, m))
+        if kind == "bwd":
+            deps.append(("fwd", s, m))
+            if s < S - 1:
+                deps.append(("bwd", s + 1, m))
+        start = max([free[s]] + [done[d] for d in deps])
+        free[s] = done[(kind, s, m)] = start + cost[kind]
+    makespan = max(free)
+    busy = sum(cost[k] for ops in per_stage for k, _ in ops)
+    return {"makespan": makespan, "busy": busy,
+            "bubble_ratio": 1.0 - busy / (S * makespan)}
+
+
+def theoretical_bubble(num_stages: int, num_microbatches: int) -> float:
+    """(S-1)/(S-1+M): the 1F1B/GPipe pipeline-fill bubble fraction."""
+    S, M = num_stages, num_microbatches
+    return (S - 1) / (S - 1 + M) if S > 1 else 0.0
 
 
 def pipeline_apply(stage_fn, stage_params, x, axis_name: str = "pipe",

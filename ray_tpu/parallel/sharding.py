@@ -9,6 +9,7 @@ NamedShardings and let GSPMD insert the collectives.
 
 from __future__ import annotations
 
+import math
 import re
 from typing import Any, Sequence
 
@@ -18,7 +19,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 PyTree = Any
 
 
-def _path_str(path) -> str:
+def path_str(path) -> str:
     parts = []
     for p in path:
         if hasattr(p, "key"):
@@ -30,6 +31,9 @@ def _path_str(path) -> str:
         else:
             parts.append(str(p))
     return "/".join(parts)
+
+
+_path_str = path_str  # pre-round-14 private name
 
 
 class PartitionRules:
@@ -81,6 +85,39 @@ def _prune_spec(spec: PartitionSpec, mesh) -> PartitionSpec:
         return entry if entry in have else None
 
     return PartitionSpec(*(prune(e) for e in spec))
+
+
+def add_axis_to_spec(spec: PartitionSpec, shape, mesh, axis: str
+                     ) -> PartitionSpec:
+    """Extend `spec` (already pruned to `mesh`) with `axis` on the first
+    dimension of `shape` that divides evenly by the combined shard count
+    — the ZeRO-style "also shard this leaf over the replica axis"
+    transformation. Leaves already touching `axis`, scalars, and leaves
+    with no evenly-divisible dimension come back unchanged (those stay
+    replicated over `axis` and are counted by the caller's ~1/N memory
+    assertion slack)."""
+    sizes = dict(mesh.shape)
+    n = sizes.get(axis, 1)
+    if n <= 1 or not shape:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+
+    def axes_of(entry):
+        if entry is None:
+            return ()
+        if isinstance(entry, (tuple, list)):
+            return tuple(entry)
+        return (entry,)
+
+    if any(axis in axes_of(e) for e in entries):
+        return spec
+    for i, dim in enumerate(shape):
+        cur = axes_of(entries[i])
+        already = math.prod(sizes.get(a, 1) for a in cur)
+        if dim % (already * n) == 0:
+            entries[i] = cur + (axis,) if cur else axis
+            return PartitionSpec(*entries)
+    return spec
 
 
 def shard_pytree(tree: PyTree, rules: PartitionRules, mesh: Mesh) -> PyTree:

@@ -20,6 +20,7 @@ from ray_tpu.train.session import (
     get_dataset_shard,
     report,
 )
+from ray_tpu.train.pipeline_strategy import PipelineStrategy
 from ray_tpu.train.spmd import TrainState, batch_shardings, make_train_step
 from ray_tpu.train.trainer import (
     FailureConfig,
@@ -36,6 +37,7 @@ __all__ = [
     "CheckpointManager",
     "FailureConfig",
     "JaxTrainer",
+    "PipelineStrategy",
     "Result",
     "RunConfig",
     "ScalingConfig",

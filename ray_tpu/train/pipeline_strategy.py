@@ -1,0 +1,388 @@
+"""1F1B pipeline-parallel train strategy over a WorkerGroup.
+
+The in-program pipeline (parallel/pipeline.py schedules inside one SPMD
+program) shares one jitted program across every device. This module is
+the MPMD promotion ("Scaling Deep Learning Training with MPMD Pipeline
+Parallelism"; Megatron schedules.py is the reference order): each
+pipeline STAGE is its own worker actor holding only its stage's
+parameters, and activations/grad-activations stream stage-to-stage
+through the object store — same-node neighbors ride the shm fast path
+(the PR 11 channel transport), cross-node neighbors the nodelet pull
+path, with no driver byte-copies either way (the driver only wires
+ObjectRefs).
+
+Scheduling is deliberately SUBMISSION-ORDER-IS-EXECUTION-ORDER: stage
+workers run FIFO (max_concurrency=1), the driver submits each stage's
+calls in its exact 1F1B order (`one_f_one_b_schedule`), and every
+call's input is an ObjectRef produced by an earlier submission
+(`one_f_one_b_submission_order` is topological) — so the gang executes
+the textbook one-forward-one-backward interleave with at most (S - s)
+live activations on stage s, and the whole schedule is testable as
+data.
+
+The bubble is measured, not assumed: each stage reports per-op busy
+time and its step window; `train_step` computes
+``bubble_ratio = 1 - busy / (S * makespan)`` and surfaces it on the
+`train_pipeline_bubble_ratio` gauge (watchtower's
+`train-pipeline-bubble` rule pages when a mis-sized microbatch count
+wastes chips). The theoretical floor (S-1)/(S-1+M) comes from
+`parallel.pipeline.theoretical_bubble`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+import cloudpickle
+import numpy as np
+
+from ray_tpu.parallel.pipeline import (
+    one_f_one_b_submission_order,
+    theoretical_bubble,
+)
+
+_bubble_gauge = None
+_micro_counter = None
+
+
+def _strategy_metrics():
+    global _bubble_gauge, _micro_counter
+    if _bubble_gauge is None:
+        from ray_tpu.util.metrics import Counter, Gauge
+
+        _bubble_gauge = Gauge(
+            "train_pipeline_bubble_ratio",
+            "Measured 1F1B pipeline bubble fraction of the last step: "
+            "1 - stage-busy / (stages * makespan); compare against "
+            "(S-1)/(S-1+M)")
+        _micro_counter = Counter(
+            "train_microbatches_total",
+            "Microbatches executed by the pipeline train strategy")
+    return _bubble_gauge, _micro_counter
+
+
+class PipelineStageWorker:
+    """Actor owning ONE pipeline stage: its parameter shard, the 1F1B
+    forward/backward for each microbatch (residuals kept per in-flight
+    microbatch via jax.vjp closures), grad accumulation, and the
+    end-of-step SGD update. Methods execute FIFO — the driver's
+    submission order is the schedule."""
+
+    def __init__(self, rank: int, world_size: int):
+        self.stage = rank
+        self.num_stages = world_size
+        self.cfg = None
+        self.params = None
+        self.lr = 0.0
+        self.num_microbatches = 1
+        self._saved: dict[int, Any] = {}  # mb -> fwd inputs (residual)
+        self._jfwd = None
+        self._jbwd = None
+        self._grads = None
+        self._spans: list[tuple[float, float]] = []
+
+    def setup_env(self, env: dict) -> bool:
+        import os
+
+        os.environ.update({k: str(v) for k, v in env.items()})
+        if "JAX_PLATFORMS" in env:
+            # jax is already imported in this process (the actor class
+            # pulls it in), so the env var alone cannot steer the
+            # backend — the config update can, as long as no jax call
+            # has initialized a backend yet (none has: load_stage is
+            # the first to touch arrays)
+            import jax
+
+            jax.config.update("jax_platforms",
+                              str(env["JAX_PLATFORMS"]) or None)
+        return True
+
+    def load_stage(self, cfg_kwargs: dict, params_blob: bytes, lr: float,
+                   num_microbatches: int) -> int:
+        """Install this stage's config + params. Returns the stage's
+        parameter count (the driver logs the split)."""
+        import jax
+
+        from ray_tpu.models.pipelined import PipelinedConfig
+
+        self.cfg = PipelinedConfig(**cfg_kwargs)
+        self.params = jax.tree.map(jax.numpy.asarray,
+                                   cloudpickle.loads(params_blob))
+        self.lr = float(lr)
+        self.num_microbatches = int(num_microbatches)
+        self._build_programs()
+        return sum(int(np.prod(x.shape))
+                   for x in jax.tree.leaves(self.params))
+
+    def _build_programs(self):
+        """Jitted forward + jitted REMATERIALIZED backward (the
+        backward re-runs the stage forward under vjp instead of keeping
+        live residual closures — so both directions hit the XLA compile
+        cache across microbatches/steps, and the only per-microbatch
+        state parked between fwd(mb) and bwd(mb) is the stage's input
+        activation, exactly the 1F1B memory shape)."""
+        import jax
+
+        from ray_tpu.models.pipelined import stage_apply
+
+        first = self.stage == 0
+        last = self.stage == self.num_stages - 1
+
+        def fn(p, x, t):
+            return stage_apply(self.cfg, p, self.stage, self.num_stages,
+                               x, targets=t)
+
+        if last:
+            self._jfwd = jax.jit(fn)
+
+            def bwd(p, x, t, g):
+                _, vjp = jax.vjp(lambda pp, xx: fn(pp, xx, t), p, x)
+                return vjp(g) if not first else (vjp(g)[0], None)
+        else:
+            self._jfwd = jax.jit(lambda p, x: fn(p, x, None))
+
+            def bwd(p, x, g):
+                _, vjp = jax.vjp(lambda pp, xx: fn(pp, xx, None), p, x)
+                # stage 0's input is int tokens: drop the float0
+                # cotangent instead of shipping it
+                return vjp(g) if not first else (vjp(g)[0], None)
+
+        self._jbwd = jax.jit(bwd)
+
+    def forward(self, mb: int, payload, targets=None):
+        """Forward one microbatch: payload is tokens (stage 0) or the
+        previous stage's activation. Returns the activation for the
+        next stage, or the microbatch loss on the last stage. The
+        inputs park as residuals until `backward(mb)`."""
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        last = self.stage == self.num_stages - 1
+        x = jnp.asarray(payload)
+        if last:
+            tgt = jnp.asarray(targets)
+            out = self._jfwd(self.params, x, tgt)
+            self._saved[mb] = (x, tgt)
+        else:
+            out = self._jfwd(self.params, x)
+            self._saved[mb] = (x,)
+        out = jax.block_until_ready(out)
+        t1 = time.perf_counter()
+        self._spans.append((t0, t1))
+        self._trace("fwd", t0, t1, mb)
+        if last:
+            # the driver reads the microbatch loss straight off this
+            # call's ObjectRef — no separate loss plumbing
+            return float(out)
+        return np.asarray(out)
+
+    def backward(self, mb: int, grad=None):
+        """Backward one microbatch: grad is the next stage's activation
+        cotangent (None on the last stage, which seeds with 1/M so the
+        accumulated grads are those of the MEAN loss). Returns the
+        cotangent for the previous stage (True from stage 0)."""
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        saved = self._saved.pop(mb)
+        if grad is None:
+            seed = jnp.float32(1.0 / self.num_microbatches)
+        else:
+            seed = jnp.asarray(grad)
+        dparams, dx = self._jbwd(self.params, *saved, seed)
+        dparams = jax.block_until_ready(dparams)
+        if self._grads is None:
+            self._grads = dparams
+        else:
+            self._grads = jax.tree.map(jnp.add, self._grads, dparams)
+        t1 = time.perf_counter()
+        self._spans.append((t0, t1))
+        self._trace("bwd", t0, t1, mb)
+        if self.stage == 0:
+            return True
+        return np.asarray(dx)
+
+    def finish_step(self) -> dict:
+        """Apply the accumulated grads (SGD, matching
+        `pipelined_train_step`) and report this stage's timing: busy
+        seconds and the step window (the driver's bubble inputs)."""
+        import jax
+
+        if self._saved:
+            raise RuntimeError(
+                f"stage {self.stage}: {len(self._saved)} microbatches "
+                f"never ran backward — schedule bug")
+        if self._grads is not None:
+            self.params = jax.tree.map(
+                lambda p, g: p - self.lr * g, self.params, self._grads)
+            self._grads = None
+        spans, self._spans = self._spans, []
+        busy = sum(t1 - t0 for t0, t1 in spans)
+        window = ((min(t0 for t0, _ in spans),
+                   max(t1 for _, t1 in spans)) if spans else (0.0, 0.0))
+        return {"stage": self.stage, "busy_s": busy,
+                "window_s": window[1] - window[0], "ops": len(spans)}
+
+    def get_params(self) -> bytes:
+        """This stage's current params (numpy tree) — checkpointing and
+        the parity tests' merge path."""
+        import jax
+
+        return cloudpickle.dumps(jax.tree.map(np.asarray, self.params))
+
+    def ping(self) -> str:
+        return "pong"
+
+    def _trace(self, kind: str, t0: float, t1: float, mb: int) -> None:
+        from ray_tpu.util import tracing
+
+        tracing.record_interval(
+            f"pipeline.stage{self.stage}.{kind}.mb{mb}", t0, t1,
+            category="train")
+
+
+@dataclasses.dataclass
+class PipelineStepMetrics:
+    loss: float
+    bubble_ratio: float
+    bubble_theoretical: float
+    step_seconds: float
+    microbatches: int
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class PipelineStrategy:
+    """Drive 1F1B pipeline-parallel training of the pipelined
+    transformer over `num_stages` stage workers.
+
+    ::
+
+        ps = PipelineStrategy(PipelinedConfig(), num_stages=2,
+                              num_microbatches=8)
+        for _ in range(steps):
+            metrics = ps.train_step({"tokens": ..., "targets": ...})
+        ps.shutdown()
+    """
+
+    def __init__(self, cfg, num_stages: int,
+                 num_microbatches: int | None = None, lr: float = 1e-2,
+                 seed: int = 0, params=None,
+                 resources_per_worker: dict | None = None,
+                 placement_strategy: str = "PACK"):
+        import jax
+
+        from ray_tpu.models.pipelined import (
+            PipelinedConfig,
+            init_pipelined,
+            split_pipeline_stages,
+        )
+        from ray_tpu.train.worker_group import WorkerGroup
+
+        self.cfg = (cfg if isinstance(cfg, PipelinedConfig)
+                    else PipelinedConfig(**dict(cfg or {})))
+        self.num_stages = num_stages
+        self.num_microbatches = int(
+            num_microbatches or self.cfg.num_microbatches)
+        self.lr = lr
+        # FIFO workers: the 1F1B submission order must BE the per-stage
+        # execution order (see module docstring)
+        self.wg = WorkerGroup(
+            num_workers=num_stages,
+            resources_per_worker=resources_per_worker,
+            placement_strategy=placement_strategy,
+            worker_cls=PipelineStageWorker,
+            max_concurrency=1,
+        )
+        try:
+            if jax.devices()[0].platform == "cpu":
+                # test/laptop path: stage workers must not grab a TPU
+                self.wg.execute("setup_env", {"JAX_PLATFORMS": "cpu"})
+            if params is None:
+                params = init_pipelined(jax.random.PRNGKey(seed),
+                                        self.cfg)
+            cfg_kwargs = dataclasses.asdict(self.cfg)
+            stages = split_pipeline_stages(params, self.cfg, num_stages)
+            self.stage_param_counts = [
+                self.wg.execute_single(
+                    s, "load_stage", cfg_kwargs,
+                    cloudpickle.dumps(
+                        jax.tree.map(np.asarray, stages[s])),
+                    lr, self.num_microbatches)
+                for s in range(num_stages)
+            ]
+        except Exception:
+            self.wg.shutdown()
+            raise
+        self.last_metrics: PipelineStepMetrics | None = None
+
+    # ------------------------------------------------------------------
+
+    def train_step(self, batch: dict) -> dict:
+        """One 1F1B step over the whole batch: split into M
+        microbatches, stream activations down / cotangents up the stage
+        chain, then apply each stage's update. Returns
+        {loss, bubble_ratio, bubble_theoretical, step_seconds,
+        microbatches}."""
+        import ray_tpu
+        from ray_tpu.util import tracing
+
+        S, M = self.num_stages, self.num_microbatches
+        tokens = np.asarray(batch["tokens"])
+        targets = np.asarray(batch["targets"])
+        B = tokens.shape[0]
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by "
+                             f"microbatches {M}")
+        mb = B // M
+        t0 = time.perf_counter()
+        with tracing.span("pipeline.train_step", category="train"):
+            fwd: dict[tuple[int, int], Any] = {}
+            bwd: dict[tuple[int, int], Any] = {}
+            for kind, s, m in one_f_one_b_submission_order(S, M):
+                w = self.wg.workers[s]
+                if kind == "fwd":
+                    payload = (tokens[m * mb:(m + 1) * mb] if s == 0
+                               else fwd[(s - 1, m)])
+                    tgt = (targets[m * mb:(m + 1) * mb]
+                           if s == S - 1 else None)
+                    fwd[(s, m)] = w.forward.remote(m, payload, tgt)
+                else:
+                    g = bwd[(s + 1, m)] if s < S - 1 else None
+                    bwd[(s, m)] = w.backward.remote(m, g)
+            losses = ray_tpu.get([fwd[(S - 1, m)] for m in range(M)],
+                                 timeout=300)
+            ray_tpu.get([bwd[(0, m)] for m in range(M)], timeout=300)
+            stats = self.wg.execute("finish_step")
+        dt = time.perf_counter() - t0
+        makespan = max(st["window_s"] for st in stats)
+        busy = sum(st["busy_s"] for st in stats)
+        bubble = (1.0 - busy / (S * makespan)) if makespan > 0 else 0.0
+        m_bubble, m_micro = _strategy_metrics()
+        m_bubble.set(bubble)
+        m_micro.inc(M)
+        self.last_metrics = PipelineStepMetrics(
+            loss=float(np.mean(losses)),
+            bubble_ratio=bubble,
+            bubble_theoretical=theoretical_bubble(S, M),
+            step_seconds=dt,
+            microbatches=M,
+        )
+        return self.last_metrics.as_dict()
+
+    def full_params(self):
+        """Merge every stage's current params back into one tree (the
+        single-program layout) — checkpoint/parity surface."""
+        from ray_tpu.models.pipelined import merge_pipeline_stages
+
+        blobs = self.wg.execute("get_params")
+        return merge_pipeline_stages(
+            [cloudpickle.loads(b) for b in blobs])
+
+    def shutdown(self):
+        self.wg.shutdown()

@@ -6,6 +6,21 @@ allreduce with a single jitted program over a mesh: parameters carry
 NamedShardings from partition rules (fsdp/tensor axes), the batch is
 sharded over (data, fsdp), and GSPMD inserts the reduce-scatter /
 all-gather traffic that DDP/ZeRO would do by hand.
+
+ZeRO-1 (`shard_optimizer=True`): optimizer-state leaves are ALSO laid
+out sharded along the data axis ("Automatic Cross-Replica Sharding of
+Weight Update in Data-Parallel Training" — each replica owns 1/N of
+the moments), and the step becomes reduce-scatter(grads) → shard-local
+optax update → all-gather(params), expressed purely as sharding
+constraints inside the single jitted program so XLA schedules/overlaps
+the collectives itself. Per-chip optimizer bytes drop ~1/data-axis-size
+(see `optimizer_state_bytes`), which is headroom for a bigger per-chip
+batch. The math is identical — sharding is layout, not arithmetic — so
+loss tracks the replicated step exactly for elementwise-stable
+optimizers (sgd/momentum); adam-family optimizers amplify the ulp-level
+reduction-order differences between two differently-partitioned XLA
+programs through mu/sqrt(nu), so their trajectories track closely but
+not bitwise (see TRAINING.md "memory math & parity").
 """
 
 from __future__ import annotations
@@ -21,8 +36,12 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ray_tpu.parallel.mesh import BATCH_AXES
-from ray_tpu.parallel.sharding import PartitionRules
+from ray_tpu.parallel.mesh import AXIS_DATA, BATCH_AXES
+from ray_tpu.parallel.sharding import (
+    PartitionRules,
+    add_axis_to_spec,
+    path_str,
+)
 
 PyTree = Any
 
@@ -38,11 +57,17 @@ class StepWaterfall:
     Phases per step: ``data_wait`` (caller-reported input fetch, see
     `note_data_wait`), ``h2d`` (host->device transfer of numpy batch
     leaves), ``compile`` (steps that tripped an XLA compile),
-    ``compute`` (dispatch + device execution), ``collective``
-    (host-side collective wall time observed during the step — the
-    in-program collective share is only visible to the device
-    profiler). Phases sum to the step's wall time (data_wait + h2d +
-    compile-or-compute; collective is carved out of compute)."""
+    ``compute`` (dispatch + device execution), and per-op
+    ``collective.<op>`` buckets (host-side collective wall time
+    observed during the step, split by the collective_seconds ``op=``
+    label — reduce_scatter / all_gather / allreduce / ... — so a ZeRO
+    step's win/cost is attributable, not inferred). Phases sum to the
+    step's wall time (data_wait + h2d + compile-or-compute; the
+    collective buckets are carved out of compute). The IN-program
+    collective share cannot be wall-timed from the host; instead the
+    compiled step's collective op census (counts by op, from the HLO)
+    is recorded alongside — see ``program_collectives`` in
+    `summary()` and the `bench.py --trace` table."""
 
     def __init__(self):
         # "0"/"false"/"" all mean OFF — an operator writing =0 to be
@@ -55,13 +80,25 @@ class StepWaterfall:
         self.steps = 0  # guarded_by(_lock)
         self._pending_data_wait = 0.0  # guarded_by(_lock)
         self._last_step_end: float | None = None  # guarded_by(_lock)
+        self.program_collectives: dict[str, int] = {}  # guarded_by(_lock)
 
     def reset(self) -> None:
+        # program_collectives survives: it describes the COMPILED step
+        # (recorded at the warmup compile), not the timing window a
+        # reset opens — resetting before a timed run must not lose it
         with self._lock:
             self.phases = {}
             self.steps = 0
             self._pending_data_wait = 0.0
             self._last_step_end = None
+
+    def note_program_collectives(self, counts: dict[str, int]) -> None:
+        """Record the compiled step's collective op census (from
+        `parallel.ops.collective_op_counts` on the optimized HLO) —
+        the structural view of in-program collective traffic the host
+        clock cannot see."""
+        with self._lock:
+            self.program_collectives = dict(counts)
 
     def step_gap(self, t_start: float, data_wait: float) -> float:
         """Host time between the previous step's end and this step's
@@ -100,11 +137,15 @@ class StepWaterfall:
         with self._lock:
             phases = dict(self.phases)
             steps = self.steps
+            prog = dict(self.program_collectives)
         total = sum(phases.values())
-        return {"steps": steps, "total_seconds": total,
-                "phases": phases,
-                "percent": {k: (100.0 * v / total if total else 0.0)
-                            for k, v in phases.items()}}
+        out = {"steps": steps, "total_seconds": total,
+               "phases": phases,
+               "percent": {k: (100.0 * v / total if total else 0.0)
+                           for k, v in phases.items()}}
+        if prog:
+            out["program_collectives"] = prog
+        return out
 
     def table(self) -> str:
         """Human attribution table: percent of step time per phase."""
@@ -112,7 +153,11 @@ class StepWaterfall:
         lines = [f"# step attribution over {s['steps']} steps "
                  f"({s['total_seconds']:.3f}s attributed)"]
         for k, v in sorted(s["phases"].items(), key=lambda kv: -kv[1]):
-            lines.append(f"#   {k:<12} {v:9.4f}s  {s['percent'][k]:5.1f}%")
+            lines.append(f"#   {k:<24} {v:9.4f}s  {s['percent'][k]:5.1f}%")
+        prog = s.get("program_collectives")
+        if prog:
+            census = " ".join(f"{k}x{v}" for k, v in sorted(prog.items()))
+            lines.append(f"# in-program collectives (per step): {census}")
         return "\n".join(lines)
 
 
@@ -170,25 +215,89 @@ def batch_shardings(mesh: Mesh, batch_example: PyTree) -> PyTree:
     return jax.tree.map(lambda _: NamedSharding(mesh, spec), batch_example)
 
 
+def zero1_shardings(
+    rules: PartitionRules, tree: PyTree, mesh: Mesh,
+    data_axis: str = AXIS_DATA,
+) -> PyTree:
+    """ZeRO-1 NamedShardings for a param-shaped tree: each leaf's rule
+    spec additionally sharded over `data_axis` on the first evenly-
+    divisible dimension, so N data-parallel replicas each own a 1/N
+    shard instead of a full copy. Leaves with no divisible dim (and
+    scalars like optimizer step counts) stay on their rule layout.
+    Works on concrete arrays and abstract (eval_shape) trees alike."""
+    def one(path, leaf):
+        spec = rules.spec_for(path_str(path), mesh)
+        return NamedSharding(
+            mesh, add_axis_to_spec(spec, leaf.shape, mesh, data_axis))
+
+    return jax.tree_util.tree_map_with_path(one, tree)
+
+
 def state_shardings(
-    rules: PartitionRules, state: TrainState, mesh: Mesh
+    rules: PartitionRules, state: TrainState, mesh: Mesh,
+    shard_optimizer: bool = False, data_axis: str = AXIS_DATA,
 ) -> TrainState:
     """NamedShardings for a TrainState. Optimizer moments are param-shaped
     subtrees whose tree paths *end with* the parameter's own path (e.g.
     `0/mu/blocks/attn_qkv/kernel`), so the same partition rules — which
     match with `re.search` — shard them identically to their parameter;
-    scalar leaves (step counts) fall through to the replicated catch-all."""
+    scalar leaves (step counts) fall through to the replicated catch-all.
+
+    ``shard_optimizer=True`` lays the optimizer state out ZeRO-1 style:
+    every moment leaf gains the `data_axis` on its first evenly-divisible
+    dimension (see `zero1_shardings`), cutting per-chip optimizer bytes
+    ~1/axis-size. Params/batch layouts are unchanged — the train step
+    reshards at the update boundary via constraints."""
     return TrainState(
         params=rules.shardings(state.params, mesh),
-        opt_state=rules.shardings(state.opt_state, mesh),
+        opt_state=(zero1_shardings(rules, state.opt_state, mesh, data_axis)
+                   if shard_optimizer
+                   else rules.shardings(state.opt_state, mesh)),
         step=NamedSharding(mesh, P()),
     )
+
+
+def optimizer_state_bytes(opt_state: PyTree) -> int:
+    """Worst-case per-device bytes resident for `opt_state`: for every
+    addressable device, sum the bytes of the shards it holds (a
+    replicated leaf contributes its full size on every device; a
+    ZeRO-1-sharded leaf 1/N), and take the max. The number the
+    `train_optimizer_state_bytes` gauge reports and the sharded-update
+    memory-win assertion gates on."""
+    per_dev: dict = {}
+    for leaf in jax.tree_util.tree_leaves(opt_state):
+        if isinstance(leaf, jax.Array):
+            for sh in leaf.addressable_shards:
+                per_dev[sh.device] = per_dev.get(sh.device, 0) \
+                    + sh.data.nbytes
+    return max(per_dev.values(), default=0)
+
+
+_opt_bytes_gauge = None
+
+
+def _optimizer_bytes_gauge():
+    global _opt_bytes_gauge
+    if _opt_bytes_gauge is None:
+        from ray_tpu.util.metrics import Gauge
+
+        _opt_bytes_gauge = Gauge(
+            "train_optimizer_state_bytes",
+            "Per-chip optimizer-state bytes (max over addressable "
+            "devices), tagged by layout=replicated|zero1 — the ZeRO-1 "
+            "memory win made visible pre/post sharding",
+            tag_keys=("layout",))
+    return _opt_bytes_gauge
 
 
 def make_train_step(
     loss_fn: Callable[[PyTree, PyTree], jax.Array],
     tx: optax.GradientTransformation,
     donate: bool = True,
+    shard_optimizer: bool = False,
+    mesh: Mesh | None = None,
+    rules: PartitionRules | None = None,
+    data_axis: str = AXIS_DATA,
 ) -> Callable[[TrainState, PyTree], tuple[TrainState, dict]]:
     """Build a jitted train step `(state, batch) -> (state, metrics)`.
 
@@ -196,13 +305,50 @@ def make_train_step(
     `init_sharded_state`, batch device_put with `batch_shardings`); jit
     propagates it and GSPMD inserts the collectives. Call under
     `with mesh:` so in-model `constrain` calls resolve.
-    """
+
+    ``shard_optimizer=True`` (requires `mesh` + `rules`; pair with a
+    state from ``init_sharded_state(..., shard_optimizer=True)``) turns
+    the update into the ZeRO-1 shape inside the SAME jitted program:
+    grads are constrained first to their rule layout (pinning the
+    backward's partitioning so the math matches the replicated step)
+    and then to the ZeRO-1 layout (reduce-scatter down to each
+    replica's 1/N shard), the optax update runs on the shards, and the
+    new params are constrained back to the rule layout (all-gather).
+    XLA sees one program and overlaps the resharding collectives with
+    backward compute; on XLA:CPU the partitioner realizes the
+    scatter as allreduce+slice, on TPU as a true reduce-scatter."""
+    if shard_optimizer and (mesh is None or rules is None):
+        raise ValueError("shard_optimizer=True needs mesh= and rules= "
+                         "to derive the ZeRO-1 layouts")
+
+    def _constrain(tree: PyTree, shardings: PyTree) -> PyTree:
+        return jax.tree.map(jax.lax.with_sharding_constraint, tree,
+                            shardings)
 
     def step(state: TrainState, batch: PyTree):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)
+        if shard_optimizer:
+            # full-layout pin, THEN the ZeRO-1 reshard: without the
+            # intermediate constraint the sharded consumer back-
+            # propagates into the backward GEMMs' partitioning and the
+            # grad arithmetic stops matching the replicated step
+            grads = _constrain(grads, rules.shardings(grads, mesh))
+
+            def z1(t):
+                return _constrain(
+                    t, zero1_shardings(rules, t, mesh, data_axis))
+
+            grads = z1(grads)
+            params_s = z1(state.params)
+            updates, new_opt = tx.update(grads, state.opt_state, params_s)
+            new_params = optax.apply_updates(params_s, updates)
+            new_params = _constrain(new_params,
+                                    rules.shardings(new_params, mesh))
+        else:
+            updates, new_opt = tx.update(grads, state.opt_state,
+                                         state.params)
+            new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(
             params=new_params, opt_state=new_opt, step=state.step + 1
         )
@@ -250,8 +396,14 @@ def make_train_step(
             # made explicit so it is timed as its own phase
             batch = jax.block_until_ready(jax.device_put(batch))
         t1 = time.perf_counter()
-        coll0 = _collective_seconds().sum_total()
+        coll0 = _collective_seconds().sums_by_tag("op")
         before = tracing.jit_cache_size(jitted)
+        # arg layouts, captured pre-call: the census lowering below
+        # needs them, and donation invalidates the arrays by then
+        args_info = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None)),
+            (state, batch))
         out = jitted(state, batch)
         # sync on the metrics dict (small leaves), not the new state:
         # blocking on loss/grad_norm means the whole step has executed
@@ -260,10 +412,31 @@ def make_train_step(
         dt = t3 - t1
         compiled = tracing.note_compile_if_grew(
             jitted, before, dt, m_miss, m_compile, "train.compile")
-        coll = min(max(0.0, _collective_seconds().sum_total() - coll0),
-                   dt)
-        phases = {"data_wait": data_wait, "h2d": t1 - t0,
-                  "collective": coll, "host": gap}
+        if compiled:
+            # collective op census of the compiled step (attribution
+            # runs only — this lowers/compiles a second executable,
+            # which is exactly the "profiling run, not record run"
+            # trade the waterfall already makes)
+            try:
+                from ray_tpu.parallel.ops import collective_op_counts
+
+                txt = jitted.lower(*args_info).compile().as_text()
+                waterfall.note_program_collectives(
+                    collective_op_counts(txt))
+            except Exception:  # noqa: BLE001 - census is best-effort
+                pass
+        coll_now = _collective_seconds().sums_by_tag("op")
+        coll_by_op = {op: v - coll0.get(op, 0.0)
+                      for op, v in coll_now.items()
+                      if v - coll0.get(op, 0.0) > 0.0}
+        coll = sum(coll_by_op.values())
+        if coll > dt > 0.0:  # clamp: collectives cannot exceed the step
+            scale = dt / coll
+            coll_by_op = {op: v * scale for op, v in coll_by_op.items()}
+            coll = dt
+        phases = {"data_wait": data_wait, "h2d": t1 - t0, "host": gap}
+        for op, v in coll_by_op.items():
+            phases[f"collective.{op}"] = v
         phases["compile" if compiled else "compute"] = dt - coll
         if not compiled:
             m_step.observe(dt)
@@ -309,17 +482,27 @@ def init_sharded_state(
     tx: optax.GradientTransformation,
     mesh: Mesh,
     rules: PartitionRules,
+    shard_optimizer: bool = False,
+    data_axis: str = AXIS_DATA,
 ) -> TrainState:
     """Initialize a TrainState directly into its sharded layout: the init
     is jitted with out_shardings so every shard is materialized on its
     owning device — no host-memory full copy (crucial for models larger
-    than one chip's HBM)."""
+    than one chip's HBM). ``shard_optimizer=True`` materializes the
+    optimizer state in its ZeRO-1 layout from the start (each replica
+    holds only its 1/data-axis shard) and reports the resulting
+    per-chip bytes on the `train_optimizer_state_bytes` gauge."""
 
     def make():
         params = init_fn()
         return TrainState.create(params, tx)
 
     abstract = jax.eval_shape(make)
-    shardings = state_shardings(rules, abstract, mesh)
+    shardings = state_shardings(rules, abstract, mesh, shard_optimizer,
+                                data_axis)
     with mesh:
-        return jax.jit(make, out_shardings=shardings)()
+        state = jax.jit(make, out_shardings=shardings)()
+    _optimizer_bytes_gauge().set(
+        float(optimizer_state_bytes(state.opt_state)),
+        tags={"layout": "zero1" if shard_optimizer else "replicated"})
+    return state

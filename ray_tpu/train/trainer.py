@@ -136,19 +136,26 @@ class JaxTrainer:
 
     def __init__(
         self,
-        train_loop_per_worker: Callable,
+        train_loop_per_worker: Callable | None = None,
         *,
         train_loop_config: dict | None = None,
         scaling_config: ScalingConfig | None = None,
         run_config: RunConfig | None = None,
         resume_from_checkpoint: Checkpoint | None = None,
         datasets: dict | None = None,
+        strategy: str = "spmd",
     ):
+        if strategy not in ("spmd", "pipeline"):
+            raise ValueError(f"unknown train strategy {strategy!r} "
+                             "(spmd | pipeline)")
+        if strategy == "spmd" and train_loop_per_worker is None:
+            raise ValueError("spmd strategy needs train_loop_per_worker")
         self._fn = train_loop_per_worker
         self._config = train_loop_config
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self._resume = resume_from_checkpoint
+        self.strategy = strategy
         # name -> ray_tpu.data.Dataset, split across the gang at start
         # (reference: DataParallelTrainer datasets= + get_dataset_shard)
         self._datasets = datasets or {}
@@ -156,6 +163,67 @@ class JaxTrainer:
     # ------------------------------------------------------------------
 
     def fit(self) -> Result:
+        if self.strategy == "pipeline":
+            return self._fit_pipeline()
+        return self._fit_spmd()
+
+    def _fit_pipeline(self) -> Result:
+        """Pipeline-parallel fit: stages on worker subsets, the 1F1B
+        schedule per step (train/pipeline_strategy.py). Config keys in
+        train_loop_config: `model` (PipelinedConfig kwargs), `batch`
+        ({tokens, targets} numpy), `steps`, `num_stages` (default:
+        scaling_config.num_workers), `num_microbatches`, `lr`,
+        `seed`."""
+        from ray_tpu.train.pipeline_strategy import PipelineStrategy
+
+        cfg = dict(self._config or {})
+        if "batch" not in cfg:
+            raise ValueError("pipeline strategy needs "
+                             "train_loop_config['batch']")
+        name = self.run_config.name or f"pipeline_{int(time.time())}"
+        storage = self.run_config.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results")
+        exp_dir = os.path.join(storage, name)
+        os.makedirs(exp_dir, exist_ok=True)
+        sc = self.scaling_config
+        ps = PipelineStrategy(
+            cfg.get("model") or {},
+            num_stages=cfg.get("num_stages", sc.num_workers),
+            num_microbatches=cfg.get("num_microbatches"),
+            lr=cfg.get("lr", 1e-2),
+            seed=cfg.get("seed", 0),
+            resources_per_worker=sc.resources_per_worker,
+            placement_strategy=sc.placement_strategy,
+        )
+        from ray_tpu import dashboard as _dash
+
+        history: list[dict] = []
+        try:
+            for step in range(int(cfg.get("steps", 1))):
+                metrics = ps.train_step(cfg["batch"])
+                metrics["step"] = step
+                history.append(metrics)
+                _dash.publish_view("train", name, {
+                    "status": "RUNNING", "iteration": len(history),
+                    "num_workers": ps.num_stages, "metrics": metrics})
+            _dash.publish_view("train", name, {
+                "status": "FINISHED", "iteration": len(history),
+                "num_workers": ps.num_stages,
+                "metrics": history[-1] if history else {}})
+        except BaseException as e:
+            # terminal-status contract matches the spmd path: a dead
+            # view must not read RUNNING forever
+            _dash.publish_view("train", name, {
+                "status": "FAILED", "iteration": len(history),
+                "error": str(e)})
+            raise
+        finally:
+            ps.shutdown()
+        return Result(metrics=history[-1] if history else {},
+                      checkpoint=None, path=exp_dir,
+                      metrics_history=history)
+
+    def _fit_spmd(self) -> Result:
         name = self.run_config.name or f"jax_trainer_{int(time.time())}"
         storage = self.run_config.storage_path or os.path.join(
             os.path.expanduser("~"), "ray_tpu_results")

@@ -172,12 +172,21 @@ class WorkerGroupError(RuntimeError):
 
 
 class WorkerGroup:
-    """N TrainWorker actors in one placement group."""
+    """N worker actors in one placement group.
+
+    The default worker class is `TrainWorker` (SPMD gangs); strategies
+    that need a different actor shape pass `worker_cls` — any class
+    whose __init__ is (rank, world_size). The pipeline strategy
+    (train/pipeline_strategy.py) runs its stage workers FIFO
+    (`max_concurrency=1`) so the driver's 1F1B submission order is the
+    per-stage execution order."""
 
     def __init__(self, num_workers: int,
                  resources_per_worker: dict[str, float] | None = None,
                  placement_strategy: str = "PACK",
-                 pg_timeout: float = 60.0):
+                 pg_timeout: float = 60.0,
+                 worker_cls: type | None = None,
+                 max_concurrency: int = 2):
         import ray_tpu
         from ray_tpu.util.placement_group import (
             placement_group,
@@ -194,12 +203,13 @@ class WorkerGroup:
             raise WorkerGroupError(
                 f"placement group for {num_workers} x {res} not placeable "
                 f"within {pg_timeout}s")
-        cls = ray_tpu.remote(num_cpus=0)(TrainWorker)
+        cls = ray_tpu.remote(num_cpus=0)(worker_cls or TrainWorker)
         self.workers = [
             cls.options(
                 placement_group=self.pg,
                 placement_group_bundle_index=i,
-                max_concurrency=2,  # next_result poll + control calls
+                # default 2: next_result poll + control calls
+                max_concurrency=max_concurrency,
             ).remote(i, num_workers)
             for i in range(num_workers)
         ]

@@ -246,6 +246,13 @@ def _get(group_name) -> _GroupState:
 
 _coll_hist = None
 
+# Round kinds -> canonical `op=` label values on collective_seconds.
+# The canonical names are shared with the in-program collective
+# attribution (parallel/ops.collective_op_counts and the step
+# waterfall's collective.<op> buckets), so host-side and in-program
+# views of "where did collective time go" use one vocabulary.
+_OP_LABELS = {"allgather": "all_gather", "reducescatter": "reduce_scatter"}
+
 
 def _collective_seconds():
     global _coll_hist
@@ -275,7 +282,8 @@ def _sync(g: _GroupState, kind, data, op=None, root=None,
                                     timeout=60)
         if ready:
             dt = time.perf_counter() - t0
-            _collective_seconds().observe(dt, tags={"op": kind})
+            _collective_seconds().observe(
+                dt, tags={"op": _OP_LABELS.get(kind, kind)})
             from ray_tpu.util import tracing
 
             tracing.record_span(f"collective.{kind}", dt,

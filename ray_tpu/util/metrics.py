@@ -159,6 +159,20 @@ class Histogram(Metric):
         with self._lock:
             return sum(self._sums.values())
 
+    def sums_by_tag(self, tag_key: str) -> dict[str, float]:
+        """Observed-value sums grouped by one tag's values (other tags
+        summed over) — what lets the step waterfall split a phase into
+        per-op buckets by diffing snapshots. Unknown tag key: {}."""
+        try:
+            i = self.tag_keys.index(tag_key)
+        except ValueError:
+            return {}
+        with self._lock:
+            out: dict[str, float] = {}
+            for k, s in self._sums.items():
+                out[k[i]] = out.get(k[i], 0.0) + s
+            return out
+
     def expose(self) -> list[str]:
         lines = [f"# HELP {self.name} {self.description}",
                  f"# TYPE {self.name} histogram"]

@@ -37,7 +37,18 @@ CATALOG: list[dict] = [
      "what": "XLA compile time for the train step"},
     {"name": "train_step_phase_seconds", "type": "histogram",
      "where": "ray_tpu/train/spmd.py",
-     "what": "per-step waterfall phases (attribution runs only)"},
+     "what": "per-step waterfall phases incl. collective.<op> buckets "
+             "(attribution runs only)"},
+    {"name": "train_optimizer_state_bytes", "type": "gauge",
+     "where": "ray_tpu/train/spmd.py",
+     "what": "per-chip optimizer-state bytes, by layout "
+             "(replicated|zero1) — the ZeRO-1 memory win"},
+    {"name": "train_pipeline_bubble_ratio", "type": "gauge",
+     "where": "ray_tpu/train/pipeline_strategy.py",
+     "what": "measured 1F1B bubble fraction of the last pipeline step"},
+    {"name": "train_microbatches_total", "type": "counter",
+     "where": "ray_tpu/train/pipeline_strategy.py",
+     "what": "microbatches executed by the pipeline train strategy"},
     # collectives
     {"name": "collective_seconds", "type": "histogram",
      "where": "ray_tpu/util/collective.py",

@@ -449,6 +449,17 @@ def default_rules() -> list[WatchRule]:
                         "2min after prior activity — a hung gang "
                         "(deadlocked collective, dead worker)"),
         WatchRule(
+            "train-pipeline-bubble",
+            metric="train_pipeline_bubble_ratio",
+            stat="last", agg="max", op=">", threshold=0.5,
+            window_s=60, for_s=60, severity="warning",
+            description="pipeline bubble ratio >0.5 sustained 60s — "
+                        "more than half the stage-seconds are idle; "
+                        "the microbatch count is mis-sized for the "
+                        "stage count (raise M toward "
+                        "bubble=(S-1)/(S-1+M)) or a stage is a "
+                        "straggler"),
+        WatchRule(
             "log-error-spike", metric="log_records_total",
             kind="rate", agg="sum", labels={"level": "error"},
             op=">", threshold=float(os.environ.get(

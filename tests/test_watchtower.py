@@ -229,7 +229,7 @@ def test_default_rule_pack_covers_catalog_signals():
     assert {"serve-ttft-slo-burn", "serve-queue-ramp",
             "replica-flapping", "span-plane-overload",
             "prefix-cache-thrash", "train-straggler",
-            "train-stall", "log-error-spike",
+            "train-stall", "train-pipeline-bubble", "log-error-spike",
             "object-stranded-refs"} == set(rules)
     for r in rules.values():
         assert r.severity in ("info", "warning", "critical")
