@@ -114,8 +114,11 @@ class _ClientHost:
 
         refs = [self._decode(r) for r in msg["refs"]]
         # always a list in, list out; the thin client unwraps singles.
-        # Blocking here is the proxy's job: c_get rides the slow lane
-        # (registered slow=True), and task_done lands on the main pool.
+        # Blocking here is the proxy's job: c_get rides the slow lane,
+        # and task_done lands on the main pool. v2 index audit: the RPC
+        # registry confirms this handler registered slow=True (the
+        # reentry analysis therefore excludes its edges — the slow pool
+        # can park without starving the control plane)
         # graftlint: disable=async-blocking
         values = ray_tpu.get(refs, timeout=msg.get("timeout", 300))
         head, views, total = ser.serialize(values)
@@ -129,6 +132,8 @@ class _ClientHost:
         refs = [self._decode(r) for r in msg["refs"]]
         by_id = {r.id.binary(): m for r, m in zip(refs, msg["refs"])}
         # synchronous proxy on the slow lane, same rationale as c_get
+        # (v2 index audit: registered slow=True, excluded from reentry
+        # edges)
         # graftlint: disable=async-blocking
         ready, pending = ray_tpu.wait(
             refs, num_returns=msg.get("num_returns", 1),

@@ -1193,7 +1193,12 @@ class ClusterRuntime:
                         # justified GL012: the spilled read must stay
                         # atomic with the ownership re-check above — a
                         # concurrent free/un-spill outside the lock
-                        # could unlink the file between check and read
+                        # could unlink the file between check and read.
+                        # v2 index audit: this open() is the ONLY
+                        # blocking effect in _h_resolve's closure under
+                        # self._lock — no callee under the lock blocks
+                        # transitively, so the critical section is
+                        # exactly one local file read
                         # graftlint: disable=blocking-under-lock
                         with open(st.spilled_path, "rb") as f:
                             return {"status": "inline"}, [f.read()]

@@ -130,14 +130,27 @@ class Head:
         s.register("actor_died", self._h_actor_died)
         s.register("get_actor", self._h_get_actor)
         s.register("get_named_actor", self._h_get_named_actor)
-        s.register("kill_actor", self._h_kill_actor)
+        # slow lane (like create_pg below): parks up to 10s on a sync
+        # stop_actor call into the nodelet, and a fast-lane handler
+        # that waits on a service whose handlers call back into the
+        # head is the GL013 reentry-cycle shape
+        s.register("kill_actor", self._h_kill_actor, slow=True)
         s.register("subscribe", self._h_subscribe)
         s.register("poll_messages", self._h_poll_messages, slow=True)
         s.register("unsubscribe", self._h_unsubscribe)
         s.register("publish", self._h_publish, oneway=True)
-        s.register("create_pg", self._h_create_pg)
+        # slow lane: the 2PC reservation loop makes one 10s-timeout RPC
+        # per bundle to the nodelets — parking that long on the
+        # control-plane pool risks starving it, and a nodelet handler
+        # synchronously calling back into the head (GL013 chain:
+        # create_pg -> reserve_bundle -> nodelet._h_schedule_task ->
+        # head cluster_view) could then deadlock the two pools against
+        # each other
+        s.register("create_pg", self._h_create_pg, slow=True)
         s.register("pg_table", self._h_pg_table)
-        s.register("remove_pg", self._h_remove_pg)
+        # slow lane: one 10s-timeout release_bundle call per bundle
+        # (same reasoning as create_pg/kill_actor)
+        s.register("remove_pg", self._h_remove_pg, slow=True)
         s.register("list_actors", self._h_list_actors)
         s.register("task_event", self._h_task_event, oneway=True)
         s.register("task_events", self._h_task_events, oneway=True)

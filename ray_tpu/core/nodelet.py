@@ -330,12 +330,15 @@ class Nodelet:
                 {"worker_id": w.worker_id.hex(),
                  "pid": getattr(w.proc, "pid", None),
                  "idle": w.idle,
-                 "actor_id": w.actor_id.hex() if w.actor_id else None,
-                 "rss_kb": rss_kb(getattr(w.proc, "pid", 0) or 0)}
+                 "actor_id": w.actor_id.hex() if w.actor_id else None}
                 for w in self._workers.values()
             ]
             avail = dict(self._available)
             qlen = len(self._queue)
+        # /proc reads stay OFF the lock: one stall (e.g. a pid being
+        # reaped) must not hold up dispatch
+        for rec in workers:
+            rec["rss_kb"] = rss_kb(rec["pid"] or 0)
         try:
             load1, load5, load15 = os.getloadavg()
         except OSError:
@@ -785,8 +788,11 @@ class Nodelet:
                     cand = oom.KillCandidate(w, owner, restartable,
                                              w.assigned_time)
                 if cand is not None:
-                    cand.rss_bytes = oom.process_rss_bytes(w.proc.pid)
                     candidates.append(cand)
+        # per-candidate /proc reads happen off the lock — a slow or
+        # vanishing /proc entry must not stall dispatch
+        for cand in candidates:
+            cand.rss_bytes = oom.process_rss_bytes(cand.worker.proc.pid)
         victim, should_retry = oom.select_worker_to_kill(
             candidates, cfg.get("WORKER_KILLING_POLICY"))
         if victim is None:

@@ -40,6 +40,37 @@ def qualname(node: ast.AST) -> str | None:
     return None
 
 
+def scan_suppressions(source: str, lines: list[str]
+                      ) -> tuple[dict[int, set[str]], set[str]]:
+    """(line -> suppressed rule names/codes, file-level set) from the
+    real COMMENT tokens of ``source``. Shared by the per-module context
+    and the semantic index (whose cached summaries must honor the same
+    directives without re-holding the source)."""
+    per_line: dict[int, set[str]] = {}
+    file_level: set[str] = set()
+    try:
+        tokens = list(tokenize.generate_tokens(
+            io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return per_line, file_level  # parse-error finding covers this
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        i = tok.start[0]
+        names = {r.strip() for r in m.group("rules").split(",")}
+        if m.group("file"):
+            file_level |= names
+        else:
+            per_line.setdefault(i, set()).update(names)
+            if lines[i - 1].lstrip().startswith("#"):
+                # standalone comment line: also covers the next line
+                per_line.setdefault(i + 1, set()).update(names)
+    return per_line, file_level
+
+
 class ModuleContext:
     def __init__(self, path: str, rel_path: str, source: str,
                  tree: ast.Module):
@@ -64,26 +95,8 @@ class ModuleContext:
     def _scan_suppressions(self) -> None:
         # real COMMENT tokens only: a directive inside a string literal
         # (a lint test fixture, a doc example) must not suppress anything
-        try:
-            tokens = list(tokenize.generate_tokens(
-                io.StringIO(self.source).readline))
-        except (tokenize.TokenError, IndentationError, SyntaxError):
-            return  # the parse-error finding already covers this file
-        for tok in tokens:
-            if tok.type != tokenize.COMMENT:
-                continue
-            m = _SUPPRESS_RE.search(tok.string)
-            if not m:
-                continue
-            i = tok.start[0]
-            names = {r.strip() for r in m.group("rules").split(",")}
-            if m.group("file"):
-                self._suppress_file |= names
-            else:
-                self._suppress_line.setdefault(i, set()).update(names)
-                if self.lines[i - 1].lstrip().startswith("#"):
-                    # standalone comment line: also covers the next line
-                    self._suppress_line.setdefault(i + 1, set()).update(names)
+        self._suppress_line, self._suppress_file = scan_suppressions(
+            self.source, self.lines)
 
     def is_suppressed(self, rule: "Rule", line: int) -> bool:
         for names in (self._suppress_file,

@@ -1,4 +1,4 @@
-"""The single-pass AST driver.
+"""The single-pass AST driver, plus the indexed second pass.
 
 One recursive walk per module; every registered rule observes every
 node in pre-order while the context keeps the class/function/lock
@@ -6,6 +6,12 @@ stacks honest. ``with`` blocks get special treatment: the context
 expressions are visited OUTSIDE the held-lock scope, the body inside —
 that is what lets the guarded-by rule see exactly which lock
 expressions protect a mutation.
+
+``lint_paths`` then builds the whole-package semantic index over the
+same file set (incremental, content-hash cached) and runs the
+registered index rules once, merging their findings into the per-file
+stream. ``lint_source`` stays per-file only — it is the
+single-module entry point and has no package to index.
 """
 
 from __future__ import annotations
@@ -71,11 +77,31 @@ def iter_python_files(paths: list[str]) -> list[str]:
 
 
 def lint_paths(paths: list[str], rules: list[Rule],
-               root: str | None = None) -> list[Finding]:
+               root: str | None = None, *,
+               index_rules: list | None = None,
+               index_cache: str | None = None) -> list[Finding]:
+    """Per-file pass over every file under ``paths``, then the index
+    rules over the whole set. ``index_rules=None`` runs all registered
+    index rules; pass ``[]`` to skip the indexed layer (that is the
+    pre-v2 single-pass engine, which the interprocedural fixture tests
+    rely on). ``index_cache`` overrides the per-root cache file; ``""``
+    disables caching."""
     root = os.path.abspath(root or os.getcwd())
+    files = [os.path.abspath(p) for p in iter_python_files(paths)]
     findings: list[Finding] = []
-    for path in iter_python_files(paths):
-        findings.extend(lint_file(os.path.abspath(path), root, rules))
+    for path in files:
+        findings.extend(lint_file(path, root, rules))
+    if index_rules is None:
+        from ray_tpu.devtools.registry import all_index_rules
+
+        index_rules = all_index_rules()
+    if index_rules:
+        from ray_tpu.devtools.semindex import build_index
+
+        index = build_index(files, root, cache_path=index_cache)
+        for r in index_rules:
+            findings.extend(r.check(index))
+        assign_occurrences(findings)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     return findings
 

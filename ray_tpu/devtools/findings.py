@@ -4,6 +4,13 @@ Fingerprints key the baseline file. They deliberately exclude the line
 NUMBER — a finding must survive unrelated edits above it — and instead
 hash the file path, rule name, the stripped source line text, and an
 occurrence index to disambiguate identical lines in one file.
+
+Findings produced by the interprocedural (semantic-index) layer carry
+a ``chain``: the call-path evidence from the reported site to the
+effect that makes it a violation, one human-readable hop per entry.
+The chain is evidence, not identity — it is excluded from the
+fingerprint so a baseline entry survives refactors that reroute the
+chain without fixing the bug.
 """
 
 from __future__ import annotations
@@ -22,6 +29,7 @@ class Finding:
     message: str
     line_text: str = ""
     occurrence: int = field(default=0)  # nth identical (path,rule,text)
+    chain: tuple = ()  # interprocedural evidence, one str per hop
 
     def fingerprint(self) -> str:
         h = hashlib.sha1()
@@ -42,6 +50,7 @@ class Finding:
             "rule": self.rule,
             "code": self.code,
             "message": self.message,
+            "chain": list(self.chain),
             "fingerprint": self.fingerprint(),
         }
 

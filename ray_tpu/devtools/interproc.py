@@ -1,0 +1,349 @@
+"""Interprocedural concurrency rules over the semantic index.
+
+These are the whole-package layers of GL009/GL012/GL013 (same rule
+name and code as the per-file layer, ``subcode = "inter"``, so one
+suppression comment covers both) plus GL017, which only exists because
+the class map does. Each finding carries ``chain`` evidence — the call
+path from the reported site to the effect that makes it a violation —
+printed by ``--explain`` and included in JSON output.
+
+Division of labor with the per-file layer, per rule:
+
+- **GL012.inter** fires on a *call* site that runs under a held
+  ``guarded_by`` lock when the callee is transitively blocking. The
+  per-file layer owns direct blocking primitives under the lock; the
+  indexed layer owns everything hidden behind a function call, so the
+  two never double-report the same site.
+- **GL013.inter** fires when a registered handler *reaches* (through
+  one or more call hops) a synchronous RPC that targets its own
+  service — either literally self-addressed, or through a multi-hop
+  cycle across service classes (A's handler calls a method of B whose
+  handler calls back into a method of A). Self-addressed RPC directly
+  in the handler body stays with the per-file layer. Same-class
+  name-only edges (A calling a method that only A registers) are NOT
+  cycle edges: peer-to-peer traffic between instances of one service
+  class on different nodes is the normal idiom. Handlers registered
+  ``slow=True`` run off the service loop and cannot deadlock it, so
+  edges out of them are skipped, as are ``send_oneway`` sends (no
+  reply to park on).
+- **GL009.inter** merges every nested acquisition — lexical and
+  lock-held-at-a-call-site-that-transitively-acquires — into one
+  global lock-order graph and reports pairwise inversions. Inversions
+  whose both directions are lexical within the same file and class are
+  the per-file layer's finding and skipped here.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.devtools.registry import IndexRule, register_index
+from ray_tpu.devtools.semindex import SemanticIndex, _is_lock_name
+
+
+def _held_guarded(index: SemanticIndex, s: dict, cls: str,
+                  held: list[str]) -> list[tuple[str, str]]:
+    """(raw, resolved lock id) for each held with-context that is a
+    lock carrying a guarded_by annotation somewhere in the package."""
+    out = []
+    for raw in held:
+        if not _is_lock_name(raw):
+            continue
+        lid = index.resolve_lock(s, cls, raw)
+        if lid in index.guarded_ids:
+            out.append((raw, lid))
+    return out
+
+
+@register_index
+class InterBlockingUnderLock(IndexRule):
+    name = "blocking-under-lock"
+    code = "GL012"
+    subcode = "inter"
+    description = ("call under a held guarded_by lock to a function "
+                   "that transitively blocks (sleeps, sync RPC, "
+                   "timeout-less result())")
+    invariant = ("critical sections guarded for cross-thread state "
+                 "stay short even when the blocking call hides behind "
+                 "helper functions")
+
+    def check(self, index: SemanticIndex) -> list:
+        findings: list = []
+        for key, (s, fn) in sorted(index.functions.items()):
+            for callee, site in index.edges.get(key, ()):
+                if callee not in index.blocking:
+                    continue
+                guarded = _held_guarded(index, s, fn["cls"],
+                                        site["held"])
+                if not guarded:
+                    continue
+                raw, lid = guarded[0]
+                chain = [f"{s['rel']}:{site['line']}: "
+                         f"{index.fn_display(key)} holds {raw} "
+                         f"(guarded_by lock {lid}) and calls "
+                         f"{index.fn_display(callee)}"]
+                chain += index.blocking_chain(callee)
+                self.report(
+                    index, findings, s["rel"], site["line"],
+                    f"call to {index.fn_display(callee)}() blocks "
+                    f"while holding guarded lock {raw} "
+                    f"(run with --explain for the call chain)",
+                    chain)
+        return findings
+
+
+@register_index
+class InterHandlerReentry(IndexRule):
+    name = "handler-reentry"
+    code = "GL013"
+    subcode = "inter"
+    description = ("RPC handler that reaches, through helper calls or "
+                   "a cycle across service classes, a synchronous RPC "
+                   "back into its own service")
+    invariant = ("a service loop never waits synchronously on itself "
+                 "— directly, through helpers, or through another "
+                 "service calling back")
+
+    def _reach(self, index: SemanticIndex, start: str):
+        """BFS over call edges: fn key -> (depth, call-hop chain)."""
+        seen = {start: (0, [])}
+        todo = [start]
+        while todo:
+            key = todo.pop(0)
+            depth, path = seen[key]
+            for callee, site in index.edges.get(key, ()):
+                if callee in seen:
+                    continue
+                rel = index.functions[key][0]["rel"]
+                hop = (f"{rel}:{site['line']}: "
+                       f"{index.fn_display(key)} calls "
+                       f"{index.fn_display(callee)}")
+                seen[callee] = (depth + 1, path + [hop])
+                todo.append(callee)
+        return seen
+
+    def check(self, index: SemanticIndex) -> list:
+        findings: list = []
+        # class-level RPC edge graph: service class -> set of service
+        # classes it synchronously calls into (from non-slow handlers),
+        # with one representative evidence record per edge
+        class_edges: dict[str, dict[str, dict]] = {}
+        sites: list[dict] = []  # every candidate (handler, rpc site)
+        for fkey in sorted(index.handler_fns):
+            for ckey, hkey, method, oneway, slow in \
+                    index.handler_fns[fkey]:
+                if slow:
+                    continue  # slow lane runs off the service loop
+                reach = self._reach(index, hkey)
+                for rkey, (depth, path) in sorted(reach.items()):
+                    rs, rfn = index.functions[rkey]
+                    if rfn["effects_annot"] is not None and \
+                            rkey != hkey:
+                        continue  # '# effects:' froze this function
+                    for rpc in rfn["rpc"]:
+                        sites.append({
+                            "cls": ckey, "handler": hkey,
+                            "method": method, "depth": depth,
+                            "path": path, "rel": rs["rel"],
+                            "rpc": rpc, "anchor": hkey
+                            if depth else rkey})
+        for site in sites:
+            rpc = site["rpc"]
+            for tgt in rpc["targets"]:
+                # ---- transitive literal self-reentry (>=1 call hop;
+                # depth 0 is the per-file layer's finding)
+                if tgt["self"] and site["depth"] >= 1:
+                    hs, hfn = index.functions[site["handler"]]
+                    chain = ([f"{hs['rel']}:{hfn['line']}: handler "
+                              f"'{site['method']}' is "
+                              f"{index.fn_display(site['handler'])}"]
+                             + site["path"]
+                             + [f"{site['rel']}:{rpc['line']}: "
+                                f"synchronous .{rpc['kind']}() targets "
+                                f"the service's own address"])
+                    self.report(
+                        index, findings, hs["rel"], hfn["line"],
+                        f"handler '{site['method']}' reaches a "
+                        f"synchronous self-targeted RPC via "
+                        f"{site['depth']} call hop(s) — the service "
+                        f"loop would wait on itself", chain)
+                # ---- class-level edges for cycle detection
+                m = tgt["method"]
+                if tgt["self"] or m is None:
+                    continue
+                for tckey, thkey, _, toneway, _ in \
+                        index.rpc_registry.get(m, ()):
+                    if tckey == site["cls"]:
+                        continue  # same-class peer traffic idiom
+                    ev = {"site": site, "target_method": m,
+                          "target_cls": tckey, "target_handler": thkey}
+                    class_edges.setdefault(
+                        site["cls"], {}).setdefault(tckey, ev)
+        # report each edge that closes a cycle back to its origin class
+        for a in sorted(class_edges):
+            for b, ev in sorted(class_edges[a].items()):
+                path = self._class_path(class_edges, b, a)
+                if path is None:
+                    continue
+                site, rpc = ev["site"], ev["site"]["rpc"]
+                hs, hfn = index.functions[site["handler"]]
+                chain = ([f"{hs['rel']}:{hfn['line']}: {a} handler "
+                          f"'{site['method']}' is "
+                          f"{index.fn_display(site['handler'])}"]
+                         + site["path"]
+                         + [f"{site['rel']}:{rpc['line']}: "
+                            f".{rpc['kind']}('{ev['target_method']}') "
+                            f"enters {b}"]
+                         + [self._edge_desc(index, hop)
+                            for hop in path])
+                self.report(
+                    index, findings, site["rel"], rpc["line"],
+                    f"handler '{site['method']}' of {a} calls "
+                    f"'{ev['target_method']}' of {b}, which can call "
+                    f"back into {a} ({len(path) + 1}-hop reentry "
+                    f"cycle)", chain)
+        return findings
+
+    def _class_path(self, class_edges: dict, start: str,
+                    goal: str) -> list[dict] | None:
+        """Edge evidence along a path start -> ... -> goal, or None."""
+        seen = {start: []}
+        todo = [start]
+        while todo:
+            c = todo.pop(0)
+            for nxt, ev in sorted(class_edges.get(c, {}).items()):
+                if nxt in seen:
+                    continue
+                seen[nxt] = seen[c] + [ev]
+                if nxt == goal:
+                    return seen[nxt]
+                todo.append(nxt)
+        return None
+
+    def _edge_desc(self, index: SemanticIndex, ev: dict) -> str:
+        site, rpc = ev["site"], ev["site"]["rpc"]
+        return (f"{site['rel']}:{rpc['line']}: {site['cls']} handler "
+                f"'{site['method']}' then calls "
+                f"'{ev['target_method']}' of {ev['target_cls']}")
+
+
+@register_index
+class InterLockOrder(IndexRule):
+    name = "lock-order"
+    code = "GL009"
+    subcode = "inter"
+    description = ("lock-order inversion in the global acquisition "
+                   "graph, including locks held in a caller while a "
+                   "callee transitively acquires another")
+    invariant = ("every pair of locks is acquired in one global order "
+                 "across the whole package, not just within one "
+                 "function")
+
+    def check(self, index: SemanticIndex) -> list:
+        # (outer lock id, inner lock id) -> [edge records]
+        edges: dict[tuple[str, str], list[dict]] = {}
+
+        def add(outer: str, inner: str, rec: dict) -> None:
+            if outer != inner:
+                edges.setdefault((outer, inner), []).append(rec)
+
+        for key, (s, fn) in sorted(index.functions.items()):
+            cls = fn["cls"]
+            if fn["effects_annot"] is not None:
+                continue  # annotated: effects (and ordering) frozen
+            for a in fn["acquires"]:
+                inner = index.resolve_lock(s, cls, a["lock"])
+                for raw in a["held"]:
+                    if not _is_lock_name(raw):
+                        continue
+                    add(index.resolve_lock(s, cls, raw), inner, {
+                        "kind": "lexical", "rel": s["rel"],
+                        "line": a["line"], "scope": (s["rel"], cls),
+                        "chain": [
+                            f"{s['rel']}:{a['line']}: "
+                            f"{index.fn_display(key)} acquires "
+                            f"{a['lock']} while holding {raw}"]})
+            for callee, site in index.edges.get(key, ()):
+                held = [(raw, index.resolve_lock(s, cls, raw))
+                        for raw in site["held"]
+                        if _is_lock_name(raw)]
+                if not held:
+                    continue
+                for inner in index.acquires.get(callee, {}):
+                    for raw, outer in held:
+                        add(outer, inner, {
+                            "kind": "call", "rel": s["rel"],
+                            "line": site["line"],
+                            "scope": (s["rel"], cls),
+                            "chain": [
+                                f"{s['rel']}:{site['line']}: "
+                                f"{index.fn_display(key)} holds {raw} "
+                                f"and calls "
+                                f"{index.fn_display(callee)}"]
+                            + index.acquire_chain(callee, inner)})
+        findings: list = []
+        for (a, b) in sorted(edges):
+            if a >= b or (b, a) not in edges:
+                continue  # visit each unordered pair once
+            fwd, rev = edges[(a, b)], edges[(b, a)]
+            if self._same_scope_lexical(fwd, rev):
+                continue  # per-file GL009 already reports this one
+            # anchor at the lexically-latest edge site so the report
+            # lands on the acquisition that completed the inversion
+            all_edges = [(e, (b, a) if e in rev else (a, b))
+                         for e in fwd + rev]
+            anchor, order = max(
+                all_edges, key=lambda p: (p[0]["rel"], p[0]["line"]))
+            other = (rev if order == (a, b) else fwd)[0]
+            chain = (anchor["chain"]
+                     + [f"...but the opposite order holds elsewhere:"]
+                     + other["chain"])
+            self.report(
+                index, findings, anchor["rel"], anchor["line"],
+                f"lock order inversion: {order[0]} -> {order[1]} "
+                f"here, but {order[1]} -> {order[0]} at "
+                f"{other['rel']}:{other['line']}", chain)
+        return findings
+
+    @staticmethod
+    def _same_scope_lexical(fwd: list[dict], rev: list[dict]) -> bool:
+        f_scopes = {e["scope"] for e in fwd if e["kind"] == "lexical"}
+        r_scopes = {e["scope"] for e in rev if e["kind"] == "lexical"}
+        return bool(f_scopes & r_scopes)
+
+
+@register_index
+class StaleGuardedBy(IndexRule):
+    name = "stale-guarded-by"
+    code = "GL017"
+    subcode = ""
+    description = ("guarded_by(<lock>) annotation naming a lock "
+                   "attribute the class (or module) never defines")
+    invariant = ("every guarded_by annotation points at a real lock, "
+                 "so the guarded-by rules enforce something")
+
+    def check(self, index: SemanticIndex) -> list:
+        findings: list = []
+        for rel in sorted(index.files):
+            s = index.files[rel]
+            for g in s["guarded"]:
+                name = g["lock"].split(".", 1)[0]
+                if self._defined(index, s, g["scope"], name):
+                    continue
+                where = (f"class {g['scope']}" if g["scope"]
+                         else f"module {s['module']}")
+                self.report(
+                    index, findings, rel, g["line"],
+                    f"guarded_by({g['lock']}) names a lock the "
+                    f"{where} never defines — stale annotation "
+                    f"guards nothing")
+        return findings
+
+    @staticmethod
+    def _defined(index: SemanticIndex, s: dict, scope: str,
+                 name: str) -> bool:
+        if name in s["module_assigns"] or name in s["imports"]:
+            return True
+        if not scope:
+            return False
+        has = index.class_defines_attr(f"{s['module']}.{scope}", name)
+        # None: a base class escapes the index — assume defined there
+        return has is not False

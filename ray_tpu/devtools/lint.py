@@ -27,7 +27,8 @@ import time
 
 from ray_tpu.devtools import baseline as baseline_mod
 from ray_tpu.devtools.driver import lint_paths
-from ray_tpu.devtools.registry import all_rules, rule_catalog
+from ray_tpu.devtools.registry import (all_index_rules, all_rules,
+                                       index_rule_catalog, rule_catalog)
 
 
 def repo_root() -> str:
@@ -46,7 +47,8 @@ def default_baseline_path() -> str:
 def run(paths: list[str], *, baseline_path: str | None = None,
         select: set[str] | None = None, root: str | None = None):
     """Programmatic entry point: returns (new, baselined) findings."""
-    findings = lint_paths(paths, all_rules(select), root=root or repo_root())
+    findings = lint_paths(paths, all_rules(select), root=root or repo_root(),
+                          index_rules=all_index_rules(select))
     known = baseline_mod.load(baseline_path) if baseline_path else {}
     return baseline_mod.split(findings, known)
 
@@ -70,7 +72,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--prune-baseline", action="store_true",
                     help="drop baseline entries that no longer fire")
     ap.add_argument("--select", default=None, metavar="RULES",
-                    help="comma-separated rule names/codes to run")
+                    help="comma-separated rule names/codes to run; "
+                         "GL012 runs both layers of a promoted rule, "
+                         "GL012.inter only the indexed one")
+    ap.add_argument("--explain", action="store_true",
+                    help="print call-chain evidence under indexed "
+                         "findings (human output)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
     args = ap.parse_args(argv)
@@ -78,6 +85,10 @@ def main(argv: list[str] | None = None) -> int:
     if args.list_rules:
         for cls in rule_catalog():
             print(f"{cls.code}  {cls.name}")
+            print(f"       {cls.description}")
+            print(f"       protects: {cls.invariant}")
+        for cls in index_rule_catalog():
+            print(f"{cls.selector()}  {cls.name} [indexed]")
             print(f"       {cls.description}")
             print(f"       protects: {cls.invariant}")
         return 0
@@ -98,7 +109,8 @@ def main(argv: list[str] | None = None) -> int:
         args.baseline or default_baseline_path())
 
     t0 = time.monotonic()
-    findings = lint_paths(paths, all_rules(select), root=repo_root())
+    findings = lint_paths(paths, all_rules(select), root=repo_root(),
+                          index_rules=all_index_rules(select))
     elapsed = time.monotonic() - t0
 
     if args.write_baseline or args.prune_baseline:
@@ -136,6 +148,9 @@ def main(argv: list[str] | None = None) -> int:
     else:
         for f in new:
             print(f.render())
+            if args.explain and f.chain:
+                for hop in f.chain:
+                    print(f"    | {hop}")
         summary = (f"graftlint: {len(new)} finding(s)"
                    + (f", {len(baselined)} baselined" if baselined else "")
                    + f" ({elapsed:.2f}s)")

@@ -16,6 +16,15 @@ Registering is one decorator::
         code = "GL099"
         description = "..."
         invariant = "..."
+
+A second registry holds **index rules** — whole-package checks that
+run once over the semantic index (``semindex.SemanticIndex``) after
+every file's single pass. An index rule has the same identity fields
+plus a ``subcode``: the interprocedural layer of an existing rule
+keeps that rule's name/code and sets ``subcode = "inter"``, so
+``--select GL012`` runs both layers while ``--select GL012.inter``
+runs only the indexed one. Suppression comments match by name or code
+and therefore cover both layers of a promoted rule.
 """
 
 from __future__ import annotations
@@ -26,8 +35,11 @@ if TYPE_CHECKING:
     import ast
 
     from ray_tpu.devtools.context import ModuleContext
+    from ray_tpu.devtools.findings import Finding
+    from ray_tpu.devtools.semindex import SemanticIndex
 
 _RULES: dict[str, type["Rule"]] = {}
+_INDEX_RULES: dict[str, type["IndexRule"]] = {}
 
 
 class Rule:
@@ -50,6 +62,37 @@ class Rule:
         pass
 
 
+class IndexRule:
+    """A whole-package check over the semantic index. ``check`` runs
+    once per lint invocation and returns findings; call-chain evidence
+    goes in each finding's ``chain``."""
+
+    name: str = ""
+    code: str = ""
+    subcode: str = ""  # "inter" for the indexed layer of a GLnnn rule
+    description: str = ""
+    invariant: str = ""
+
+    @classmethod
+    def selector(cls) -> str:
+        return f"{cls.code}.{cls.subcode}" if cls.subcode else cls.code
+
+    def check(self, index: "SemanticIndex") -> list["Finding"]:
+        raise NotImplementedError
+
+    def report(self, index: "SemanticIndex", findings: list["Finding"],
+               rel: str, line: int, message: str,
+               chain: tuple | list = ()) -> None:
+        from ray_tpu.devtools.findings import Finding
+
+        if index.is_suppressed(rel, line, {self.name, self.code}):
+            return
+        findings.append(Finding(
+            path=rel, line=line, col=0, rule=self.name, code=self.code,
+            message=message, line_text=index.line_text(rel, line),
+            chain=tuple(chain)))
+
+
 def register(cls: type[Rule]) -> type[Rule]:
     if not cls.name or not cls.code:
         raise ValueError(f"rule {cls.__name__} needs name and code")
@@ -59,20 +102,44 @@ def register(cls: type[Rule]) -> type[Rule]:
     return cls
 
 
-def all_rules(select: set[str] | None = None) -> list[Rule]:
-    """Instantiate registered rules (loading the bundled rule package
-    on first use). ``select`` filters by name or code; unknown entries
-    raise — a typo silently selecting zero rules would turn the lint
-    gate into a no-op that reports clean."""
+def register_index(cls: type[IndexRule]) -> type[IndexRule]:
+    if not cls.name or not cls.code:
+        raise ValueError(f"index rule {cls.__name__} needs name and code")
+    key = cls.selector()
+    if key in _INDEX_RULES and _INDEX_RULES[key] is not cls:
+        raise ValueError(f"duplicate index rule selector {key!r}")
+    _INDEX_RULES[key] = cls
+    return cls
+
+
+def _load_bundled() -> None:
+    from ray_tpu.devtools import interproc as _inter  # noqa: F401
     from ray_tpu.devtools import rules as _bundled  # noqa: F401
 
-    if select:
-        known = {c.name for c in _RULES.values()} | {
-            c.code for c in _RULES.values()}
-        unknown = set(select) - known
-        if unknown:
-            raise ValueError(
-                f"unknown rule selector(s): {', '.join(sorted(unknown))}")
+
+def _validate_select(select: set[str] | None) -> None:
+    """Unknown selectors raise — a typo silently selecting zero rules
+    would turn the lint gate into a no-op that reports clean. The known
+    set spans both registries so ``GL017`` or ``GL012.inter`` validate
+    when filtering per-file rules (and vice versa)."""
+    if not select:
+        return
+    known: set[str] = set()
+    for c in _RULES.values():
+        known |= {c.name, c.code}
+    for c in _INDEX_RULES.values():
+        known |= {c.name, c.code, c.selector()}
+    unknown = set(select) - known
+    if unknown:
+        raise ValueError(
+            f"unknown rule selector(s): {', '.join(sorted(unknown))}")
+
+
+def all_rules(select: set[str] | None = None) -> list[Rule]:
+    """Instantiate registered per-file rules (loading the bundled rule
+    package on first use). ``select`` filters by name or code."""
+    _load_bundled()
+    _validate_select(select)
     out = []
     for cls in sorted(_RULES.values(), key=lambda c: c.code):
         if select and cls.name not in select and cls.code not in select:
@@ -81,7 +148,26 @@ def all_rules(select: set[str] | None = None) -> list[Rule]:
     return out
 
 
-def rule_catalog() -> list[type[Rule]]:
-    from ray_tpu.devtools import rules as _bundled  # noqa: F401
+def all_index_rules(select: set[str] | None = None) -> list[IndexRule]:
+    """Instantiate registered index rules. ``select`` filters by name,
+    code, or ``code.subcode`` (``GL012`` runs both layers of a promoted
+    rule; ``GL012.inter`` only the indexed one)."""
+    _load_bundled()
+    _validate_select(select)
+    out = []
+    for _, cls in sorted(_INDEX_RULES.items()):
+        if select and cls.name not in select and cls.code not in select \
+                and cls.selector() not in select:
+            continue
+        out.append(cls())
+    return out
 
+
+def rule_catalog() -> list[type[Rule]]:
+    _load_bundled()
     return sorted(_RULES.values(), key=lambda c: c.code)
+
+
+def index_rule_catalog() -> list[type[IndexRule]]:
+    _load_bundled()
+    return [_INDEX_RULES[k] for k in sorted(_INDEX_RULES)]

@@ -27,6 +27,12 @@ The snapshot-then-act pattern (copy under the lock, call outside) is
 the sanctioned fix. ``Condition.wait`` is NOT flagged — it releases
 the lock while parked, which is the whole point of conditions.
 Justified exceptions use ``# graftlint: disable=blocking-under-lock``.
+
+This is the PER-FILE layer: it owns blocking primitives lexically
+under the lock. The indexed layer (``interproc.py``, selector
+``GL012.inter``) owns blocking that hides behind a function call —
+both share ``semindex.blocking_call_label`` as the single definition
+of "blocking", so the two layers can never disagree about what blocks.
 """
 
 from __future__ import annotations
@@ -34,14 +40,11 @@ from __future__ import annotations
 import ast
 import re
 
-from ray_tpu.devtools.context import ModuleContext, qualname
+from ray_tpu.devtools.context import ModuleContext
 from ray_tpu.devtools.registry import Rule, register
+from ray_tpu.devtools.semindex import blocking_call_label
 
 _ANNOT_RE = re.compile(r"#.*?guarded_by\(\s*(?:self\.)?([\w\.]+)\s*\)")
-
-_RPC_METHODS = {"call", "call_frames", "call_gather"}
-_BLOCKING_RESOLVED = {"time.sleep", "ray_tpu.get", "ray_tpu.wait",
-                      "open"}
 
 
 @register
@@ -101,33 +104,11 @@ class BlockingUnderLockRule(Rule):
     def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
         if not self._enabled or not ctx.lock_stack:
             return
-        label = self._blocking_label(node, ctx)
+        label = blocking_call_label(node, ctx.resolve)
         if label is None:
             return
         scope = ctx.current_class.name if ctx.current_class else ""
         self._events.append((scope, tuple(ctx.lock_stack), node, label))
-
-    def _blocking_label(self, node: ast.Call, ctx: ModuleContext
-                        ) -> str | None:
-        f = node.func
-        if isinstance(f, (ast.Name, ast.Attribute)):
-            qn = qualname(f)
-            if qn is not None and ctx.resolve(qn) in _BLOCKING_RESOLVED:
-                return ctx.resolve(qn)
-        if isinstance(f, ast.Attribute):
-            if f.attr in _RPC_METHODS:
-                recv = qualname(f.value)
-                if recv is not None and "client" in recv.lower():
-                    return f"{recv}.{f.attr}"
-                if isinstance(f.value, ast.Call):
-                    inner = qualname(f.value.func)
-                    if inner is not None and \
-                            inner.endswith("RpcClient.shared"):
-                        return f"RpcClient.shared().{f.attr}"
-            if f.attr == "result" and not node.args and \
-                    not node.keywords:
-                return "Future.result() without timeout"
-        return None
 
     # ------------------------------------------------------------ end pass
 

@@ -23,8 +23,9 @@ import re
 from ray_tpu.devtools.context import ModuleContext
 from ray_tpu.devtools.registry import Rule, register
 
-# anywhere in a trailing comment, so it composes with existing notes:
-#   self._queue = deque()  # task queue; guarded_by(_lock)
+# anywhere in a trailing comment, so it composes with existing notes
+# (the next line is a doc EXAMPLE, not an annotation of this module):
+#   self._queue = deque()  # guarded_by(_lock)  # graftlint: disable=stale-guarded-by
 _ANNOT_RE = re.compile(r"#.*?guarded_by\(\s*(?:self\.)?([\w\.]+)\s*\)")
 
 _MUTATORS = {

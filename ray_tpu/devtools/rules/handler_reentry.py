@@ -22,6 +22,13 @@ target resolves to the server's own address — a first argument of
 NON-handler thread and have the handler read the gathered state (the
 head's watchtower/metrics_history split), or ``send_oneway`` (no reply
 to park on), or move the work to a different process/server.
+
+This per-file layer owns self-addressed RPC directly in the handler
+body. The indexed layer (``interproc.py``, selector ``GL013.inter``)
+owns the reentry the single pass cannot see: a self-targeted RPC
+reached through helper calls, and multi-hop cycles across service
+classes (A's handler synchronously calls a method of B whose handler
+calls back into A).
 """
 
 from __future__ import annotations

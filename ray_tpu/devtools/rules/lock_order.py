@@ -21,9 +21,11 @@ Only attribute chains whose last component mentions ``lock`` (e.g.
 considered: `with` is also files/meshes/spans, and a lint that
 second-guesses every context manager would drown the real signal.
 Cross-function and cross-class inversions (A held while *calling* a
-method that takes B) are out of scope — interprocedural analysis costs
-more than the convention it protects; document leaf locks instead,
-like cache.BlockPool does.
+method that transitively takes B) belong to the indexed layer
+(``interproc.py``, selector ``GL009.inter``), which merges every
+acquisition — lexical and via the call graph — into one global
+lock-order graph; this per-file layer keeps owning inversions whose
+both directions are lexical within one file and class.
 """
 
 from __future__ import annotations

@@ -1,0 +1,839 @@
+"""The whole-package semantic index — graftlint's second analysis layer.
+
+The single-pass driver sees one module at a time, which is exactly the
+blind spot the runtime's worst bugs live in: a blocking call moved into
+a helper, an RPC hop through a second service, a lock acquired in a
+callee. This module builds, in one pre-pass over every file handed to
+the linter:
+
+- a **call graph** keyed by qualified name: ``self.meth()`` resolved
+  through the class map (including bases, cross-module), ``self.attr.
+  meth()`` through statically-evident attribute types (``self.attr =
+  SomeClass(...)``), bare names through nested defs then module
+  functions, dotted names through the import-alias table;
+- a **class map**: methods, bases, attribute assignments (with the
+  constructor type where evident), lock attributes, and the
+  ``guarded_by(<lock>)`` annotations scoped to each class/module;
+- the **RPC registry**: every ``<server>.register("name", handler[,
+  oneway=][, slow=])`` site mapped to its handler function, per
+  service class;
+- an inferred **effect set** per function — ``blocking`` (with the
+  originating label), ``acquires:<lock>`` — computed as a transitive
+  closure over the call graph, each effect carrying a witness so the
+  interprocedural rules can print the full call chain as evidence.
+
+Dynamic dispatch is where static closure gives up; ``# effects:``
+annotations take over there. On the ``def`` line (or the comment line
+directly above the def / its first decorator)::
+
+    # effects: none                      <- callee closure cut: inert
+    # effects: blocking                  <- treat as blocking
+    # effects: acquires:self._lock       <- treat as taking the lock
+    # effects: blocking, acquires:_LOCK  <- combine freely
+
+An annotated function's effect set is exactly what it declares —
+inference neither adds to nor propagates through it.
+
+Incrementality: per-file extraction results are cached in a JSON file
+keyed by content hash (default: a per-root file under the system temp
+dir), so a clean re-run re-parses nothing and an edit re-extracts only
+the changed files. Linking and the effect closure always recompute —
+they are whole-package by definition and cost milliseconds.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+import tempfile
+from dataclasses import dataclass, field
+
+from ray_tpu.devtools.context import qualname, scan_suppressions
+
+CACHE_VERSION = 1
+
+_EFFECTS_RE = re.compile(r"#\s*effects:\s*(?P<labels>[\w\s:,.\-]+)")
+_ANNOT_RE = re.compile(r"#.*?guarded_by\(\s*(?:self\.)?([\w\.]+)\s*\)")
+
+_RPC_METHODS = ("call", "call_frames", "call_gather")
+_BLOCKING_RESOLVED = {"time.sleep", "ray_tpu.get", "ray_tpu.wait",
+                      "open"}
+_SELF_ADDRS = ("self.address", "self.server.address")
+_LOCK_TYPE_TAILS = ("Lock", "RLock", "Condition", "Semaphore",
+                    "BoundedSemaphore")
+
+
+def _is_lock_name(qn: str) -> bool:
+    return "lock" in qn.rsplit(".", 1)[-1].lower()
+
+
+def blocking_call_label(node: ast.Call, resolve) -> str | None:
+    """The label of a directly-blocking call, or None. ``resolve`` maps
+    a local dotted name to its import-resolved origin. This is THE
+    definition of "blocking" — GL012's per-file pass and the index's
+    effect inference both use it, so the two layers can never disagree
+    about what blocks."""
+    f = node.func
+    if isinstance(f, (ast.Name, ast.Attribute)):
+        qn = qualname(f)
+        if qn is not None and resolve(qn) in _BLOCKING_RESOLVED:
+            return resolve(qn)
+    if isinstance(f, ast.Attribute):
+        if f.attr in _RPC_METHODS:
+            recv = qualname(f.value)
+            if recv is not None and "client" in recv.lower():
+                return f"{recv}.{f.attr}"
+            if isinstance(f.value, ast.Call):
+                inner = qualname(f.value.func)
+                if inner is not None and \
+                        inner.endswith("RpcClient.shared"):
+                    return f"RpcClient.shared().{f.attr}"
+        if f.attr == "result" and not node.args and not node.keywords:
+            return "Future.result() without timeout"
+    return None
+
+
+def module_name_of(rel_path: str) -> str:
+    """Dotted module name for a repo-relative path."""
+    p = rel_path.replace("\\", "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+# --------------------------------------------------------------- extraction
+
+
+class _Extractor(ast.NodeVisitor):
+    """One walk over a module's AST producing the JSON-serializable
+    per-file summary the index links from (and the cache stores)."""
+
+    def __init__(self, source: str, rel_path: str):
+        self.rel = rel_path.replace("\\", "/")
+        self.module = module_name_of(self.rel)
+        self.lines = source.splitlines()
+        self.imports: dict[str, str] = {}
+        self.functions: dict[str, dict] = {}
+        self.classes: dict[str, dict] = {}
+        self.module_assigns: set[str] = set()
+        self.guarded: list[dict] = []   # {scope, lock, line, text}
+        self.handlers: list[dict] = []  # {scope, method, handler,
+        #                                  oneway, slow, line}
+        self._class_stack: list[str] = []
+        self._func_stack: list[str] = []
+        self._with_stack: list[str] = []
+        sup_line, sup_file = scan_suppressions(source, self.lines)
+        self.suppress_line = {str(k): sorted(v)
+                              for k, v in sup_line.items()}
+        self.suppress_file = sorted(sup_file)
+
+    # ------------------------------------------------------------ helpers
+
+    def _resolve(self, name: str) -> str:
+        head, _, rest = name.partition(".")
+        origin = self.imports.get(head, head)
+        return f"{origin}.{rest}" if rest else origin
+
+    def _line_text(self, line: int) -> str:
+        return self.lines[line - 1] if 0 < line <= len(self.lines) else ""
+
+    def _fn(self) -> dict | None:
+        if not self._func_stack:
+            return None
+        return self.functions[".".join(self._scope_parts())]
+
+    def _scope_parts(self) -> list[str]:
+        return self._class_stack[:1] + self._func_stack
+
+    def _class_info(self) -> dict | None:
+        if not self._class_stack:
+            return None
+        return self.classes[self._class_stack[0]]
+
+    def _effects_annotation(self, node) -> list[str] | None:
+        first = min([node.lineno]
+                    + [d.lineno for d in node.decorator_list])
+        for line in (node.lineno, first - 1):
+            text = self._line_text(line)
+            m = _EFFECTS_RE.search(text)
+            if m:
+                return [t.strip() for t in m.group("labels").split(",")
+                        if t.strip()]
+        return None
+
+    # ------------------------------------------------------------- visits
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            self.imports[a.asname or a.name.split(".")[0]] = a.name
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        mod = node.module or ""
+        for a in node.names:
+            self.imports[a.asname or a.name] = (
+                f"{mod}.{a.name}" if mod else a.name)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        if self._class_stack or self._func_stack:
+            # nested classes are rare and out of static reach
+            self.generic_visit(node)
+            return
+        self.classes[node.name] = {
+            "line": node.lineno,
+            "bases": [self._resolve(qn) for qn in
+                      (qualname(b) for b in node.bases)
+                      if qn is not None],
+            "methods": [],
+            "attrs": {},        # attr -> constructor type or ""
+            "class_attrs": [],  # names assigned in the class body
+        }
+        self._class_stack.append(node.name)
+        try:
+            for child in node.body:
+                if isinstance(child, ast.Assign):
+                    for t in child.targets:
+                        if isinstance(t, ast.Name):
+                            self.classes[node.name]["class_attrs"].append(
+                                t.id)
+                elif isinstance(child, ast.AnnAssign) and \
+                        isinstance(child.target, ast.Name):
+                    self.classes[node.name]["class_attrs"].append(
+                        child.target.id)
+                self.visit(child)
+        finally:
+            self._class_stack.pop()
+
+    def _visit_functiondef(self, node) -> None:
+        cls = self._class_info()
+        if cls is not None and not self._func_stack:
+            cls["methods"].append(node.name)
+        self._func_stack.append(node.name)
+        key = ".".join(self._scope_parts())
+        self.functions[key] = {
+            "line": node.lineno,
+            "cls": self._class_stack[0] if self._class_stack else "",
+            "effects_annot": self._effects_annotation(node),
+            "calls": [],      # {raw, kind, name, attr, line, held}
+            "blocking": [],   # {label, line, held, local_guard}
+            "acquires": [],   # {lock, line, held}
+            "rpc": [],        # {kind, line, held, targets}
+            "nested": [],
+        }
+        if len(self._func_stack) > 1:
+            outer = ".".join(self._scope_parts()[:-1])
+            self.functions[outer]["nested"].append(node.name)
+        saved_with = self._with_stack
+        self._with_stack = []  # a nested def runs on its caller's stack
+        try:
+            for dec in node.decorator_list:
+                self.visit(dec)
+            for child in node.body:
+                self.visit(child)
+        finally:
+            self._with_stack = saved_with
+            self._func_stack.pop()
+
+    visit_FunctionDef = _visit_functiondef
+    visit_AsyncFunctionDef = _visit_functiondef
+
+    def _visit_with(self, node) -> None:
+        held = []
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+            qn = qualname(item.context_expr)
+            if qn is not None:
+                held.append(qn)
+                if _is_lock_name(qn):
+                    fn = self._fn()
+                    if fn is not None:
+                        fn["acquires"].append({
+                            "lock": qn, "line": item.context_expr.lineno,
+                            "held": list(self._with_stack)})
+        self._with_stack.extend(held)
+        try:
+            for child in node.body:
+                self.visit(child)
+        finally:
+            if held:
+                del self._with_stack[-len(held):]
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._record_assign(node.targets, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._record_assign([node.target], node.value)
+        self.generic_visit(node)
+
+    def _record_assign(self, targets, value) -> None:
+        ctor = ""
+        if isinstance(value, ast.Call):
+            qn = qualname(value.func)
+            if qn is not None:
+                ctor = self._resolve(qn)
+        for t in targets:
+            if isinstance(t, ast.Name) and not self._func_stack and \
+                    not self._class_stack:
+                self.module_assigns.add(t.id)
+            cls = self._class_info()
+            if cls is not None and isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                cls["attrs"].setdefault(t.attr, ctor)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = self._fn()
+        f = node.func
+        # ---- RPC handler registration (class map feeding the registry)
+        if isinstance(f, ast.Attribute) and f.attr == "register" and \
+                len(node.args) >= 2 and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            handler = node.args[1]
+            if isinstance(handler, ast.Call) and len(handler.args) == 1:
+                # decorator-style wrapper at the register site, e.g.
+                # register("c_get", alive(self._h_get), slow=True)
+                handler = handler.args[0]
+            hname = (handler.attr if isinstance(handler, ast.Attribute)
+                     else handler.id if isinstance(handler, ast.Name)
+                     else None)
+            if hname is not None:
+                flags = {k.arg: bool(getattr(k.value, "value", False))
+                         for k in node.keywords if k.arg}
+                oneway = flags.get("oneway", bool(
+                    len(node.args) >= 3
+                    and getattr(node.args[2], "value", False)))
+                self.handlers.append({
+                    "scope": self._class_stack[0]
+                    if self._class_stack else "",
+                    "method": node.args[0].value, "handler": hname,
+                    "oneway": oneway, "slow": flags.get("slow", False),
+                    "line": node.lineno})
+        if fn is not None:
+            self._record_call(node, fn)
+        self.generic_visit(node)
+
+    def _record_call(self, node: ast.Call, fn: dict) -> None:
+        f = node.func
+        held = list(self._with_stack)
+        label = blocking_call_label(node, self._resolve)
+        if label is not None:
+            fn["blocking"].append({
+                "label": label, "line": node.lineno, "held": held})
+        # ---- synchronous RPC sites (for the handler-reentry graph)
+        if isinstance(f, ast.Attribute) and f.attr in _RPC_METHODS:
+            recv = qualname(f.value)
+            is_client = (recv is not None and "client" in recv.lower()) \
+                or (isinstance(f.value, ast.Call)
+                    and (qualname(f.value.func) or "").endswith(
+                        "RpcClient.shared"))
+            if is_client:
+                fn["rpc"].append({
+                    "kind": f.attr, "line": node.lineno, "held": held,
+                    "targets": self._rpc_targets(f.attr, node)})
+        # ---- call-graph edge candidates
+        qn = qualname(f)
+        if qn is None:
+            return
+        rec = {"raw": qn, "line": node.lineno, "held": held}
+        if qn.startswith("self."):
+            parts = qn.split(".")[1:]
+            if len(parts) == 1:
+                rec.update(kind="self", name=parts[0])
+            elif len(parts) == 2:
+                rec.update(kind="attr", attr=parts[0], name=parts[1])
+            else:
+                return
+        elif "." not in qn:
+            rec.update(kind="local", name=qn)
+        else:
+            rec.update(kind="abs", name=self._resolve(qn))
+        fn["calls"].append(rec)
+
+    def _rpc_targets(self, kind: str, node: ast.Call) -> list[dict]:
+        """[{self: bool, method: str|None}] for one RPC site. ``call``
+        and ``call_frames`` take (addr, method, ...); ``call_gather``
+        a literal list of (addr, method, msg) tuples when static."""
+        out: list[dict] = []
+
+        def one(addr, meth) -> dict:
+            method = None
+            if isinstance(meth, ast.Constant) and \
+                    isinstance(meth.value, str):
+                method = meth.value
+            return {"self": qualname(addr) in _SELF_ADDRS,
+                    "method": method}
+
+        if kind in ("call", "call_frames") and len(node.args) >= 2:
+            out.append(one(node.args[0], node.args[1]))
+        elif kind == "call_gather" and node.args and \
+                isinstance(node.args[0], (ast.List, ast.Tuple)):
+            for elt in node.args[0].elts:
+                if isinstance(elt, ast.Tuple) and len(elt.elts) >= 2:
+                    out.append(one(elt.elts[0], elt.elts[1]))
+        return out
+
+    # ------------------------------------------------- guarded_by comments
+
+    def scan_guarded(self, tree: ast.Module) -> None:
+        spans = [(n.lineno, getattr(n, "end_lineno", n.lineno), n.name)
+                 for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]
+        for i, line in enumerate(self.lines, start=1):
+            m = _ANNOT_RE.search(line)
+            if not m:
+                continue
+            scope = ""
+            best = None
+            for lo, hi, name in spans:
+                if lo <= i <= hi and (best is None or lo > best[0]):
+                    best = (lo, name)
+            if best is not None:
+                scope = best[1]
+            self.guarded.append({"scope": scope, "lock": m.group(1),
+                                 "line": i, "text": line})
+
+    def summary(self) -> dict:
+        return {
+            "module": self.module, "rel": self.rel,
+            "imports": self.imports, "functions": self.functions,
+            "classes": self.classes,
+            "module_assigns": sorted(self.module_assigns),
+            "guarded": self.guarded, "handlers": self.handlers,
+            "suppress_line": self.suppress_line,
+            "suppress_file": self.suppress_file,
+            "lines": self.lines,
+        }
+
+
+def extract_summary(source: str, rel_path: str) -> dict:
+    try:
+        tree = ast.parse(source, filename=rel_path)
+    except SyntaxError:
+        # the per-file pass reports GL000; the index just skips it
+        return {"module": module_name_of(rel_path), "rel": rel_path,
+                "error": "syntax", "imports": {}, "functions": {},
+                "classes": {}, "module_assigns": [], "guarded": [],
+                "handlers": [], "suppress_line": {},
+                "suppress_file": [], "lines": []}
+    ex = _Extractor(source, rel_path)
+    ex.visit(tree)
+    ex.scan_guarded(tree)
+    return ex.summary()
+
+
+# ------------------------------------------------------------------ linking
+
+
+@dataclass
+class BuildStats:
+    extracted: list[str] = field(default_factory=list)  # rel paths
+    cached: list[str] = field(default_factory=list)
+
+
+class SemanticIndex:
+    """Linked whole-package view over the per-file summaries."""
+
+    def __init__(self, summaries: dict[str, dict],
+                 stats: BuildStats | None = None):
+        self.files = summaries          # rel path -> summary
+        self.stats = stats or BuildStats()
+        self.modules: dict[str, dict] = {
+            s["module"]: s for s in summaries.values()}
+        # "module.Class" -> (summary, class info)
+        self.classes: dict[str, tuple[dict, dict]] = {}
+        for s in summaries.values():
+            for cname, cinfo in s["classes"].items():
+                self.classes[f"{s['module']}.{cname}"] = (s, cinfo)
+        # function key "module::scope" -> (summary, fn info)
+        self.functions: dict[str, tuple[dict, dict]] = {}
+        for s in summaries.values():
+            for scope, fn in s["functions"].items():
+                self.functions[f"{s['module']}::{scope}"] = (s, fn)
+        self._link()
+        self._close_effects()
+
+    # ---------------------------------------------------------- utilities
+
+    def fn_display(self, key: str) -> str:
+        mod, _, scope = key.partition("::")
+        return f"{mod}.{scope}"
+
+    def fn_site(self, key: str) -> tuple[str, int]:
+        s, fn = self.functions[key]
+        return s["rel"], fn["line"]
+
+    def line_text(self, rel: str, line: int) -> str:
+        lines = self.files.get(rel, {}).get("lines", [])
+        return lines[line - 1] if 0 < line <= len(lines) else ""
+
+    def is_suppressed(self, rel: str, line: int, names: set[str]) -> bool:
+        s = self.files.get(rel)
+        if s is None:
+            return False
+        if names & set(s["suppress_file"]):
+            return True
+        at = set(s["suppress_line"].get(str(line), ()))
+        return bool(at and ("all" in at or at & names))
+
+    def resolve_class(self, resolved: str) -> str | None:
+        """'pkg.mod.Cls' (import-resolved) -> class key, if indexed."""
+        if resolved in self.classes:
+            return resolved
+        return None
+
+    def class_mro(self, ckey: str) -> list[str]:
+        """ckey + resolvable bases (mapped from their import-resolved
+        names back to class keys), BFS, cycles guarded."""
+        out, todo = [], [ckey]
+        while todo:
+            k = todo.pop(0)
+            if k in out or k not in self.classes:
+                continue
+            out.append(k)
+            s, cinfo = self.classes[k]
+            for b in cinfo["bases"]:
+                bk = self._resolve_classref(s, b)
+                if bk is not None:
+                    todo.append(bk)
+        return out
+
+    def resolve_method(self, ckey: str, name: str) -> str | None:
+        for k in self.class_mro(ckey):
+            s, cinfo = self.classes[k]
+            if name in cinfo["methods"]:
+                return f"{s['module']}::{k.rsplit('.', 1)[1]}.{name}"
+        return None
+
+    def class_defines_attr(self, ckey: str, attr: str) -> bool | None:
+        """True/False if decidable, None when a base class escapes the
+        index (conservative: the attribute may live there)."""
+        for k in self.class_mro(ckey):
+            s, cinfo = self.classes[k]
+            if attr in cinfo["attrs"] or attr in cinfo["class_attrs"]:
+                return True
+        for k in self.class_mro(ckey):
+            s, cinfo = self.classes[k]
+            for b in cinfo["bases"]:
+                if self._resolve_classref(s, b) is None:
+                    return None
+        return False
+
+    def _attr_type(self, s: dict, cls: str, attr: str) -> str | None:
+        """Class key of ``self.<attr>`` in class ``cls``, if the
+        constructor assignment made it statically evident."""
+        for k in self.class_mro(f"{s['module']}.{cls}"):
+            cs, cinfo = self.classes[k]
+            ctor = cinfo["attrs"].get(attr, "")
+            if ctor:
+                ck = self._resolve_classref(cs, ctor)
+                if ck is not None:
+                    return ck
+        return None
+
+    def _resolve_classref(self, s: dict, resolved: str) -> str | None:
+        """Import-resolved constructor string -> class key."""
+        if resolved in s["classes"]:
+            return f"{s['module']}.{resolved}"
+        if resolved in self.classes:
+            return resolved
+        return None
+
+    def _resolve_global(self, resolved: str) -> str | None:
+        """Import-resolved dotted name -> function key, by longest
+        module prefix."""
+        parts = resolved.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:i])
+            s = self.modules.get(mod)
+            if s is None:
+                continue
+            scope = ".".join(parts[i:])
+            if scope in s["functions"]:
+                return f"{mod}::{scope}"
+            if len(parts) - i == 2:
+                cls, meth = parts[i], parts[i + 1]
+                if cls in s["classes"]:
+                    return self.resolve_method(f"{mod}.{cls}", meth)
+            return None
+        return None
+
+    # ------------------------------------------------------------ linking
+
+    def resolve_lock(self, s: dict, cls: str, raw: str) -> str:
+        """Global identity for a lock expression seen in class ``cls``
+        of summary ``s``. Statically-evident attribute types unify
+        ``self._pool._lock`` with the pool class's own ``_lock``."""
+        mod = s["module"]
+        if raw.startswith("self."):
+            parts = raw.split(".")[1:]
+            if len(parts) == 1:
+                return f"{mod}.{cls}.{parts[0]}" if cls else \
+                    f"{mod}.{parts[0]}"
+            if len(parts) == 2 and cls:
+                ck = self._attr_type(s, cls, parts[0])
+                if ck is not None:
+                    return f"{ck}.{parts[1]}"
+            return f"{mod}.{cls}.{'.'.join(parts)}"
+        if "." not in raw:
+            if raw in s["module_assigns"]:
+                return f"{mod}.{raw}"
+            return f"{mod}.{cls}~{raw}" if cls else f"{mod}~{raw}"
+        return f"{mod}.{cls}~{raw}" if cls else f"{mod}~{raw}"
+
+    def _link(self) -> None:
+        # guarded lock ids, global: lock id -> (rel, line) of annotation
+        self.guarded_ids: dict[str, tuple[str, int]] = {}
+        for s in self.files.values():
+            for g in s["guarded"]:
+                lock = g["lock"]
+                raw = lock if lock.startswith("self.") or \
+                    not g["scope"] else f"self.{lock}"
+                lid = self.resolve_lock(s, g["scope"], raw)
+                self.guarded_ids.setdefault(lid, (s["rel"], g["line"]))
+        # rpc registry: method name -> [(class key, handler fn key,
+        #                                oneway, slow)]
+        self.rpc_registry: dict[str, list[tuple]] = {}
+        self.handler_fns: dict[str, list[tuple]] = {}  # fn key -> regs
+        for s in self.files.values():
+            for h in s["handlers"]:
+                if not h["scope"]:
+                    continue
+                ckey = f"{s['module']}.{h['scope']}"
+                fkey = self.resolve_method(ckey, h["handler"])
+                if fkey is None:
+                    continue
+                entry = (ckey, fkey, h["method"], h["oneway"], h["slow"])
+                self.rpc_registry.setdefault(h["method"], []).append(entry)
+                self.handler_fns.setdefault(fkey, []).append(entry)
+        # call edges: fn key -> [(callee key, site dict)]
+        self.edges: dict[str, list[tuple[str, dict]]] = {}
+        for key, (s, fn) in self.functions.items():
+            scope = key.partition("::")[2]
+            cls = fn["cls"]
+            out = []
+            for c in fn["calls"]:
+                callee = self._resolve_callee(s, scope, cls, c)
+                if callee is not None and callee in self.functions:
+                    out.append((callee, c))
+            self.edges[key] = out
+        self.redges: dict[str, list[tuple[str, dict]]] = {}
+        for caller, outs in self.edges.items():
+            for callee, site in outs:
+                self.redges.setdefault(callee, []).append((caller, site))
+
+    def _resolve_callee(self, s: dict, scope: str, cls: str,
+                        c: dict) -> str | None:
+        mod = s["module"]
+        kind = c.get("kind")
+        if kind == "self" and cls:
+            return self.resolve_method(f"{mod}.{cls}", c["name"])
+        if kind == "attr" and cls:
+            ck = self._attr_type(s, cls, c["attr"])
+            if ck is not None:
+                return self.resolve_method(ck, c["name"])
+            return None
+        if kind == "local":
+            # nested def of the current function first, then module fn,
+            # then an import-resolved origin
+            fn = s["functions"].get(scope)
+            if fn and c["name"] in fn["nested"]:
+                return f"{mod}::{scope}.{c['name']}"
+            if c["name"] in s["functions"]:
+                return f"{mod}::{c['name']}"
+            origin = s["imports"].get(c["name"])
+            if origin is not None:
+                return self._resolve_global(origin)
+            return None
+        if kind == "abs":
+            return self._resolve_global(c["name"])
+        return None
+
+    # ----------------------------------------------------------- effects
+
+    def _annotated(self, key: str) -> list[str] | None:
+        return self.functions[key][1]["effects_annot"]
+
+    def _close_effects(self) -> None:
+        """Fixpoint over the call graph for ``blocking`` and
+        ``acquires:<lock>``; each entry carries a witness for chain
+        reconstruction: ("direct", rel, line, label) |
+        ("call", callee_key, rel, line) | ("annot", rel, line)."""
+        self.blocking: dict[str, tuple] = {}
+        self.acquires: dict[str, dict[str, tuple]] = {}
+        todo: list[str] = []
+
+        def set_blocking(key: str, witness: tuple) -> None:
+            if key not in self.blocking:
+                self.blocking[key] = witness
+                todo.append(key)
+
+        def add_acquire(key: str, lock: str, witness: tuple) -> None:
+            locks = self.acquires.setdefault(key, {})
+            if lock not in locks:
+                locks[lock] = witness
+                todo.append(key)
+
+        for key, (s, fn) in self.functions.items():
+            annot = fn["effects_annot"]
+            rel, line = s["rel"], fn["line"]
+            if annot is not None:
+                for label in annot:
+                    if label == "blocking":
+                        set_blocking(key, ("annot", rel, line))
+                    elif label.startswith("acquires:"):
+                        lock = self.resolve_lock(
+                            s, fn["cls"], label.split(":", 1)[1])
+                        add_acquire(key, lock, ("annot", rel, line))
+                continue
+            for b in fn["blocking"]:
+                set_blocking(key, ("direct", rel, b["line"], b["label"]))
+            for r in fn["rpc"]:
+                set_blocking(key, ("direct", rel, r["line"],
+                                   f"sync RPC .{r['kind']}()"))
+            for a in fn["acquires"]:
+                lock = self.resolve_lock(s, fn["cls"], a["lock"])
+                add_acquire(key, lock, ("direct", rel, a["line"],
+                                        f"with {a['lock']}"))
+
+        while todo:
+            key = todo.pop()
+            for caller, site in self.redges.get(key, ()):
+                if self._annotated(caller) is not None:
+                    continue  # annotation freezes the caller's effects
+                rel = self.functions[caller][0]["rel"]
+                if key in self.blocking and caller not in self.blocking:
+                    set_blocking(caller,
+                                 ("call", key, rel, site["line"]))
+                for lock in self.acquires.get(key, {}):
+                    if lock not in self.acquires.get(caller, {}):
+                        add_acquire(caller, lock,
+                                    ("call", key, rel, site["line"]))
+
+    # ------------------------------------------------------------- chains
+
+    def blocking_chain(self, key: str) -> list[str]:
+        """Human-readable witness path from ``key`` to the blocking
+        primitive."""
+        out: list[str] = []
+        seen = set()
+        while key not in seen:
+            seen.add(key)
+            w = self.blocking.get(key)
+            if w is None:
+                break
+            if w[0] == "direct":
+                out.append(f"{w[1]}:{w[2]}: {self.fn_display(key)} "
+                           f"blocks: {w[3]}")
+                break
+            if w[0] == "annot":
+                out.append(f"{w[1]}:{w[2]}: {self.fn_display(key)} "
+                           f"declared '# effects: blocking'")
+                break
+            _, callee, rel, line = w
+            out.append(f"{rel}:{line}: {self.fn_display(key)} calls "
+                       f"{self.fn_display(callee)}")
+            key = callee
+        return out
+
+    def acquire_chain(self, key: str, lock: str) -> list[str]:
+        out: list[str] = []
+        seen = set()
+        while key not in seen:
+            seen.add(key)
+            w = self.acquires.get(key, {}).get(lock)
+            if w is None:
+                break
+            if w[0] == "direct":
+                out.append(f"{w[1]}:{w[2]}: {self.fn_display(key)} "
+                           f"acquires {lock} ({w[3]})")
+                break
+            if w[0] == "annot":
+                out.append(f"{w[1]}:{w[2]}: {self.fn_display(key)} "
+                           f"declared '# effects: acquires:{lock}'")
+                break
+            _, callee, rel, line = w
+            out.append(f"{rel}:{line}: {self.fn_display(key)} calls "
+                       f"{self.fn_display(callee)}")
+            key = callee
+        return out
+
+
+# -------------------------------------------------------------------- cache
+
+
+def default_cache_path(root: str) -> str:
+    tag = hashlib.sha1(os.path.abspath(root).encode()).hexdigest()[:12]
+    uid = getattr(os, "getuid", lambda: 0)()
+    return os.path.join(tempfile.gettempdir(),
+                        f"graftlint-index-{uid}-{tag}.json")
+
+
+def _load_cache(path: str) -> dict:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("version") != CACHE_VERSION:
+            return {}
+        return data.get("files", {})
+    except (OSError, ValueError):
+        return {}
+
+
+def _save_cache(path: str, files: dict) -> None:
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"version": CACHE_VERSION, "files": files}, f)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def build_index(paths: list[str], root: str,
+                cache_path: str | None = None) -> SemanticIndex:
+    """Build the index over ``paths`` (absolute file paths), caching
+    per-file extraction by content hash. ``cache_path=''`` disables
+    the cache entirely."""
+    root = os.path.abspath(root).rstrip(os.sep)
+    if cache_path is None:
+        cache_path = default_cache_path(root)
+    cached = _load_cache(cache_path) if cache_path else {}
+    out: dict[str, dict] = {}
+    fresh: dict[str, dict] = {}
+    stats = BuildStats()
+    for path in paths:
+        rel = path[len(root) + 1:] if path.startswith(root + os.sep) \
+            else path
+        rel = rel.replace("\\", "/")
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            continue
+        digest = hashlib.sha1(raw).hexdigest()
+        entry = cached.get(rel)
+        if entry is not None and entry.get("hash") == digest:
+            out[rel] = entry["summary"]
+            fresh[rel] = entry
+            stats.cached.append(rel)
+            continue
+        summary = extract_summary(
+            raw.decode("utf-8", errors="replace"), rel)
+        out[rel] = summary
+        fresh[rel] = {"hash": digest, "summary": summary}
+        stats.extracted.append(rel)
+    if cache_path:
+        _save_cache(cache_path, fresh)
+    return SemanticIndex(out, stats)

@@ -286,7 +286,11 @@ class SpanSpill:
                 # exactly this append/rotate pair — concurrent appenders
                 # outside it would interleave half-lines into the JSONL;
                 # the lock is private to the spill (the head's span
-                # buffer lock is NOT held here)
+                # buffer lock is NOT held here). v2 index audit: every
+                # acquisition of SpanSpill._lock (append, read) happens
+                # with no other lock held, and nothing called under it
+                # acquires — the lock has zero edges in the global
+                # lock-order graph
                 # graftlint: disable=blocking-under-lock
                 with open(self._cur, "ab") as f:
                     f.write(blob)

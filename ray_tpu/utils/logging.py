@@ -143,8 +143,10 @@ class LogSink:
                     # justified GL012: this lock exists to serialize
                     # exactly this append/rotate pair (concurrent
                     # writers would interleave half-lines into the
-                    # JSONL); it is private to the sink and never nests
-                    # another lock
+                    # JSONL). v2 index audit: the only acquisition it
+                    # nests is metrics.Counter._lock (chain: LogSink.
+                    # write -> Counter.inc), a leaf lock with no
+                    # outgoing order edges, so no inversion is possible
                     # graftlint: disable=blocking-under-lock
                     self._fh = open(self.path, "ab")
                 self._fh.write(blob)

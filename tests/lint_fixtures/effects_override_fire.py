@@ -1,0 +1,25 @@
+"""``# effects:`` override fire: dynamic dispatch the closure cannot
+see, declared blocking by annotation.
+
+``_run_hook`` calls through a stored callable — statically inert, so
+without the annotation the index would infer no effects. The
+``# effects: blocking`` line declares what dispatch hides, and
+GL012.inter fires on the call under the guarded lock.
+"""
+
+import threading
+
+
+class HookRunner:
+    def __init__(self, hook):
+        self._lock = threading.Lock()
+        self._hook = hook
+        self._state = {}  # guarded_by(_lock)
+
+    # effects: blocking
+    def _run_hook(self):
+        return self._hook()
+
+    def update(self, key):
+        with self._lock:
+            self._state[key] = self._run_hook()  # GL012.inter

@@ -1,0 +1,29 @@
+"""``# effects:`` override ok twin: an annotation FREEZES a
+function's effect set.
+
+``_observe`` statically reaches open() through ``_read``, so without
+the annotation GL012.inter would fire on the call under the guarded
+lock. ``# effects: none`` declares the function inert (here: the read
+is served from an in-memory fake in every deployment that matters),
+and inference neither adds to nor propagates through it.
+"""
+
+import threading
+
+
+class HookRunner:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}  # guarded_by(_lock)
+
+    # effects: none
+    def _observe(self):
+        return self._read()
+
+    def _read(self):
+        with open("/proc/self/stat") as f:
+            return f.read()
+
+    def update(self, key):
+        with self._lock:
+            self._state[key] = self._observe()

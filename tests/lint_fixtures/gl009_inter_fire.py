@@ -1,0 +1,77 @@
+"""GL009.inter fire: lock-order inversions invisible per-file.
+
+Two inversion pairs: (1) Engine nests Engine._lock -> Pool._pool_lock
+lexically, while Reaper nests the same pair the other way around —
+different classes, so the per-file (per-class-scope) pass never pairs
+them; (2) Cache.put HOLDS Cache._cache_lock while calling a Registry
+method that ACQUIRES Registry._reg_lock (the lock-held-in-caller /
+acquired-in-callee shape), while Sweeper nests the opposite order
+lexically. Attribute types are statically evident (constructor
+assignments), so the index unifies ``self.pool._pool_lock`` with
+Pool's own ``_pool_lock``.
+"""
+
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._pool_lock = threading.Lock()
+        self.stats = {}
+
+    def add(self, key):
+        with self._pool_lock:
+            self.stats[key] = 1
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pool = Pool()
+
+    def submit(self, key):
+        with self._lock:
+            with self.pool._pool_lock:
+                self.pool.stats[key] = 1
+
+
+class Reaper:
+    def __init__(self):
+        self.engine = Engine()
+        self.pool = Pool()
+
+    def drain(self):
+        with self.pool._pool_lock:
+            with self.engine._lock:  # GL009.inter (vs Engine.submit)
+                return dict(self.pool.stats)
+
+
+class Registry:
+    def __init__(self):
+        self._reg_lock = threading.Lock()
+        self.items = {}
+
+    def note(self, key):
+        with self._reg_lock:
+            self.items[key] = 1
+
+
+class Cache:
+    def __init__(self):
+        self._cache_lock = threading.Lock()
+        self.registry = Registry()
+
+    def put(self, key):
+        with self._cache_lock:
+            self.registry.note(key)  # acquires Registry._reg_lock
+
+
+class Sweeper:
+    def __init__(self):
+        self.registry = Registry()
+        self.cache = Cache()
+
+    def sweep(self):
+        with self.registry._reg_lock:
+            with self.cache._cache_lock:  # GL009.inter (vs Cache.put)
+                return len(self.registry.items)

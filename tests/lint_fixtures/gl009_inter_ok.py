@@ -1,0 +1,68 @@
+"""GL009.inter ok twin: every path takes the locks in ONE global
+order (coordination lock before leaf lock), so the global graph has
+edges but no cycles."""
+
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._pool_lock = threading.Lock()
+        self.stats = {}
+
+    def add(self, key):
+        with self._pool_lock:
+            self.stats[key] = 1
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pool = Pool()
+
+    def submit(self, key):
+        with self._lock:
+            with self.pool._pool_lock:
+                self.pool.stats[key] = 1
+
+
+class Reaper:
+    def __init__(self):
+        self.engine = Engine()
+        self.pool = Pool()
+
+    def drain(self):
+        with self.engine._lock:
+            with self.pool._pool_lock:
+                return dict(self.pool.stats)
+
+
+class Registry:
+    def __init__(self):
+        self._reg_lock = threading.Lock()
+        self.items = {}
+
+    def note(self, key):
+        with self._reg_lock:
+            self.items[key] = 1
+
+
+class Cache:
+    def __init__(self):
+        self._cache_lock = threading.Lock()
+        self.registry = Registry()
+
+    def put(self, key):
+        with self._cache_lock:
+            self.registry.note(key)
+
+
+class Sweeper:
+    def __init__(self):
+        self.registry = Registry()
+        self.cache = Cache()
+
+    def sweep(self):
+        with self.cache._cache_lock:
+            with self.registry._reg_lock:
+                return len(self.registry.items)

@@ -1,0 +1,34 @@
+"""GL012.inter fire: the blocking call hides behind a helper.
+
+The per-file pass sees only a plain method call under the lock and
+stays quiet; the indexed effect closure sees that the callee
+transitively reaches open() / time.sleep() and fires at the call
+site, with the chain as evidence.
+"""
+
+import threading
+import time
+
+
+class SpillManager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}  # guarded_by(_lock)
+
+    def _read_disk(self, path):
+        with open(path, "rb") as f:
+            return f.read()
+
+    def _nap(self):
+        time.sleep(0.01)
+
+    def lookup(self, key, path):
+        with self._lock:
+            if key not in self._table:
+                self._table[key] = self._read_disk(path)  # GL012.inter
+            return self._table[key]
+
+    def touch(self, key):
+        with self._lock:
+            self._nap()  # GL012.inter
+            self._table[key] = 1

@@ -1,0 +1,36 @@
+"""GL012.inter ok twin: snapshot under the lock, block outside it.
+
+Same helpers as the fire fixture, but every transitively blocking
+call happens with the guarded lock released.
+"""
+
+import threading
+import time
+
+
+class SpillManager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}  # guarded_by(_lock)
+
+    def _read_disk(self, path):
+        with open(path, "rb") as f:
+            return f.read()
+
+    def _nap(self):
+        time.sleep(0.01)
+
+    def lookup(self, key, path):
+        with self._lock:
+            cached = self._table.get(key)
+        if cached is not None:
+            return cached
+        data = self._read_disk(path)
+        with self._lock:
+            self._table[key] = data
+        return data
+
+    def touch(self, key):
+        self._nap()
+        with self._lock:
+            self._table[key] = 1

@@ -1,0 +1,56 @@
+"""GL013.inter fire: reentry the single pass cannot see.
+
+Three shapes: (1) a 2-hop cycle across two service classes — Alpha's
+handler synchronously calls a method of Beta whose handler calls back
+into a method of Alpha (both edges reported, one per direction); (2) a
+self-targeted synchronous RPC reached through a helper call instead of
+sitting in the handler body. No handler body contains a self-addressed
+call, so the per-file GL013 pass is quiet on this file.
+"""
+
+
+class Alpha:
+    def __init__(self, server, client, beta_addr):
+        self.server = server
+        self.client = client
+        self.beta_addr = beta_addr
+        server.register("alpha_step", self._h_step)
+        server.register("alpha_info", self._h_info)
+
+    def _h_info(self, msg, frames):
+        return {"ok": True}
+
+    def _h_step(self, msg, frames):
+        return self._forward(msg)
+
+    def _forward(self, msg):
+        return self.client.call(self.beta_addr, "beta_pull", msg,
+                                timeout=5)  # GL013.inter (cycle)
+
+
+class Beta:
+    def __init__(self, server, client, alpha_addr):
+        self.server = server
+        self.client = client
+        self.alpha_addr = alpha_addr
+        server.register("beta_pull", self._h_pull)
+
+    def _h_pull(self, msg, frames):
+        return self.client.call(self.alpha_addr, "alpha_info", msg,
+                                timeout=5)  # GL013.inter (cycle)
+
+
+class Gamma:
+    def __init__(self, server, client):
+        self.server = server
+        self.client = client
+        self.address = server.address
+        server.register("gamma_sync", self._h_sync)
+
+    def _h_sync(self, msg, frames):  # GL013.inter (transitive self)
+        return self._refresh(msg)
+
+    def _refresh(self, msg):
+        # self-targeted, but one call hop away from the handler body
+        return self.client.call(self.address, "gamma_sync", msg,
+                                timeout=5)

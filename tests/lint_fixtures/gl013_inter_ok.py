@@ -1,0 +1,69 @@
+"""GL013.inter ok twin: each fire shape, defused the sanctioned way.
+
+Alpha -> Beta stays one-directional because Beta's callback rides
+send_oneway (no reply to park on). Delta's same-class call is the
+peer-to-peer idiom (another NODE's instance of the same service) and
+is not a cycle edge. Epsilon's transitive self-call is fine because
+the handler is registered slow=True — the slow pool can park without
+starving the service loop.
+"""
+
+
+class Alpha:
+    def __init__(self, server, client, beta_addr):
+        self.server = server
+        self.client = client
+        self.beta_addr = beta_addr
+        server.register("alpha_step", self._h_step)
+        server.register("alpha_note", self._h_note, oneway=True)
+
+    def _h_note(self, msg, frames):
+        self.last = msg
+
+    def _h_step(self, msg, frames):
+        return self._forward(msg)
+
+    def _forward(self, msg):
+        return self.client.call(self.beta_addr, "beta_pull", msg,
+                                timeout=5)
+
+
+class Beta:
+    def __init__(self, server, client, alpha_addr):
+        self.server = server
+        self.client = client
+        self.alpha_addr = alpha_addr
+        server.register("beta_pull", self._h_pull)
+
+    def _h_pull(self, msg, frames):
+        self.client.send_oneway(self.alpha_addr, "alpha_note", msg)
+        return {"ok": True}
+
+
+class Delta:
+    def __init__(self, server, client, peer_addr):
+        self.client = client
+        self.peer_addr = peer_addr
+        server.register("delta_pull", self._h_pull)
+
+    def _h_pull(self, msg, frames):
+        return self._fetch(msg)
+
+    def _fetch(self, msg):
+        # same service class on a DIFFERENT node: peer-to-peer pull
+        return self.client.call(self.peer_addr, "delta_pull", msg,
+                                timeout=5)
+
+
+class Epsilon:
+    def __init__(self, server, client):
+        self.client = client
+        self.address = server.address
+        server.register("eps_gather", self._h_gather, slow=True)
+
+    def _h_gather(self, msg, frames):
+        return self._pull(msg)
+
+    def _pull(self, msg):
+        return self.client.call(self.address, "eps_ping", msg,
+                                timeout=5)

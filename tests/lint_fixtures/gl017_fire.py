@@ -1,0 +1,19 @@
+"""GL017 fire: guarded_by annotations naming locks nobody defines.
+
+Tracker annotates with ``_items_lock`` but only ever creates
+``_lock``; the module-level annotation names ``_counts_lock`` which no
+module assignment (or import) provides. Both annotations guard
+nothing — the guarded-by rules silently enforce a lock that cannot be
+held.
+"""
+
+import threading
+
+
+class Tracker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = {}  # guarded_by(_items_lock)   GL017: never defined
+
+
+_counts = {}  # guarded_by(_counts_lock)   GL017: never defined

@@ -1,0 +1,33 @@
+"""GL017 ok twin: every annotation resolves to a real lock.
+
+Tracker defines its lock; SubTracker inherits it through a base the
+index can resolve; External's base escapes the index entirely, so the
+rule stays conservative (the lock may live there); the module-level
+annotation names a real module global.
+"""
+
+import threading
+
+from some_external_pkg import BaseStore
+
+
+class Tracker:
+    def __init__(self):
+        self._items_lock = threading.Lock()
+        self.items = {}  # guarded_by(_items_lock)
+
+
+class SubTracker(Tracker):
+    def __init__(self):
+        super().__init__()
+        self.extra = {}  # guarded_by(_items_lock)
+
+
+class External(BaseStore):
+    def __init__(self):
+        super().__init__()
+        self.data = {}  # guarded_by(_store_lock)
+
+
+_counts_lock = threading.Lock()
+_counts = {}  # guarded_by(_counts_lock)

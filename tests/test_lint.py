@@ -1,10 +1,12 @@
 """graftlint tier-1 tests.
 
 Covers: every rule firing on its fixture and staying quiet on the
-clean twin, suppression comments, the baseline round-trip, and — the
-gate that matters — a clean full-package run: ``ray_tpu/`` must have
-zero non-baselined findings (and this repo's committed baseline is
-empty, so zero findings, full stop).
+clean twin, the interprocedural (semantic-index) layer firing on
+cross-function shapes the single-pass engine provably misses,
+suppression comments, the baseline round-trip, the index cache, and —
+the gate that matters — a clean full-package run: ``ray_tpu/`` must
+have zero non-baselined findings (and this repo's committed baseline
+is empty, so zero findings, full stop) in under 10 seconds.
 """
 
 import json
@@ -16,14 +18,18 @@ import pytest
 from ray_tpu.devtools import baseline as baseline_mod
 from ray_tpu.devtools.driver import lint_paths, lint_source
 from ray_tpu.devtools.lint import default_baseline_path, main, repo_root
-from ray_tpu.devtools.registry import all_rules, rule_catalog
+from ray_tpu.devtools.registry import (all_index_rules, all_rules,
+                                       index_rule_catalog, rule_catalog)
+from ray_tpu.devtools.semindex import build_index
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
 
 
-def lint_fixture(name):
+def lint_fixture(name, index_rules=None):
+    # index_cache="" keeps fixture runs hermetic (no shared temp cache)
     return lint_paths([os.path.join(FIXTURES, name)], all_rules(),
-                      root=FIXTURES)
+                      root=FIXTURES, index_rules=index_rules,
+                      index_cache="")
 
 
 # -------------------------------------------------------------- rule cases
@@ -70,12 +76,138 @@ def test_rule_catalog_complete():
         "GL015", "GL016"]
     for cls in catalog:
         assert cls.name and cls.description and cls.invariant
+    index_catalog = index_rule_catalog()
+    assert [c.selector() for c in index_catalog] == [
+        "GL009.inter", "GL012.inter", "GL013.inter", "GL017"]
+    for cls in index_catalog:
+        assert cls.name and cls.description and cls.invariant
 
 
 def test_select_filters_rules():
     findings = lint_paths([os.path.join(FIXTURES, "gl006_fire.py")],
-                          all_rules({"GL002"}), root=FIXTURES)
+                          all_rules({"GL002"}), root=FIXTURES,
+                          index_cache="")
     assert findings == []  # only the discarded-future rule ran
+
+
+# ------------------------------------------- the indexed (v2) layer
+
+# (code, fire fixture, ok fixture, expected finding count). Every fire
+# fixture is a shape the pre-v2 single-pass engine PROVABLY misses —
+# asserted below by running it with the indexed layer disabled.
+INTER_CASES = [
+    ("GL012", "gl012_inter_fire.py", "gl012_inter_ok.py", 2),
+    ("GL013", "gl013_inter_fire.py", "gl013_inter_ok.py", 3),
+    ("GL009", "gl009_inter_fire.py", "gl009_inter_ok.py", 2),
+    ("GL012", "effects_override_fire.py", "effects_override_ok.py", 1),
+    ("GL017", "gl017_fire.py", "gl017_ok.py", 2),
+]
+
+
+@pytest.mark.parametrize("code,fire,ok,n_expected", INTER_CASES,
+                         ids=[c[1][:-3] for c in INTER_CASES])
+def test_interprocedural_fires_and_stays_quiet(code, fire, ok,
+                                               n_expected):
+    firing = lint_fixture(fire)
+    assert [f.code for f in firing] == [code] * n_expected, (
+        f"{fire}: expected {n_expected} {code} findings, got "
+        f"{[(f.code, f.line, f.message) for f in firing]}")
+    if code != "GL017":  # GL017 needs no chain: the annotation IS the site
+        assert all(f.chain for f in firing), "indexed finding lost its chain"
+    clean = lint_fixture(ok)
+    assert clean == [], (
+        f"{ok} should be clean, got "
+        f"{[(f.code, f.line, f.message) for f in clean]}")
+
+
+@pytest.mark.parametrize("code,fire,ok,n_expected", INTER_CASES,
+                         ids=[c[1][:-3] for c in INTER_CASES])
+def test_single_pass_engine_misses_inter_fixture(code, fire, ok,
+                                                 n_expected):
+    """The point of the index: the per-file engine alone (index_rules
+    disabled — exactly the pre-v2 behavior) sees nothing here."""
+    assert lint_fixture(fire, index_rules=[]) == []
+
+
+def test_effects_annotation_freezes_inference():
+    """The ok twin only differs from firing by its '# effects: none'
+    line — inference would flag the statically-blocking callee."""
+    src = open(os.path.join(FIXTURES, "effects_override_ok.py")).read()
+    assert "# effects: none" in src
+    assert lint_fixture("effects_override_ok.py") == []
+
+
+def test_chain_excluded_from_fingerprint():
+    f1, f2 = lint_fixture("gl012_inter_fire.py")
+    bare = type(f1)(path=f1.path, line=f1.line, col=f1.col,
+                    rule=f1.rule, code=f1.code, message=f1.message,
+                    line_text=f1.line_text, occurrence=f1.occurrence)
+    assert f1.chain and bare.fingerprint() == f1.fingerprint()
+
+
+def test_select_inter_sublayer_only():
+    """GL012.inter selects only the indexed layer; plain GL012 both."""
+    inter_only = lint_paths(
+        [os.path.join(FIXTURES, "gl012_fire.py")],
+        all_rules({"GL012.inter"}), root=FIXTURES,
+        index_rules=all_index_rules({"GL012.inter"}), index_cache="")
+    assert inter_only == []  # per-file shapes: inter layer is quiet
+    both = lint_paths(
+        [os.path.join(FIXTURES, "gl012_inter_fire.py")],
+        all_rules({"GL012"}), root=FIXTURES,
+        index_rules=all_index_rules({"GL012"}), index_cache="")
+    assert [f.code for f in both] == ["GL012", "GL012"]
+    with pytest.raises(ValueError):
+        all_index_rules({"GL099.inter"})
+
+
+def test_suppression_covers_indexed_layer(tmp_path):
+    src = open(os.path.join(FIXTURES, "gl012_inter_fire.py")).read()
+    src = src.replace(
+        "self._table[key] = self._read_disk(path)  # GL012.inter",
+        "self._table[key] = self._read_disk(path)  "
+        "# graftlint: disable=blocking-under-lock")
+    src = src.replace(
+        "self._nap()  # GL012.inter",
+        "self._nap()  # graftlint: disable=GL012")
+    p = tmp_path / "suppressed_inter.py"
+    p.write_text(src)
+    findings = lint_paths([str(p)], all_rules(), root=str(tmp_path),
+                          index_cache="")
+    assert findings == []
+
+
+# ------------------------------------------------------ index cache
+
+def test_index_cache_invalidation(tmp_path):
+    a, b = tmp_path / "a.py", tmp_path / "b.py"
+    a.write_text("def f():\n    return 1\n")
+    b.write_text("def g():\n    return 2\n")
+    cache = str(tmp_path / "cache.json")
+    paths, root = [str(a), str(b)], str(tmp_path)
+
+    idx = build_index(paths, root, cache_path=cache)
+    assert sorted(idx.stats.extracted) == ["a.py", "b.py"]
+    # clean re-run: everything served from the content-hash cache
+    idx = build_index(paths, root, cache_path=cache)
+    assert idx.stats.extracted == []
+    assert sorted(idx.stats.cached) == ["a.py", "b.py"]
+    # edit one file: only it re-extracts
+    a.write_text("def f():\n    return 3\n")
+    idx = build_index(paths, root, cache_path=cache)
+    assert idx.stats.extracted == ["a.py"]
+    assert idx.stats.cached == ["b.py"]
+
+
+def test_index_cache_warm_run_same_findings(tmp_path):
+    cache = str(tmp_path / "cache.json")
+    fixture = os.path.join(FIXTURES, "gl009_inter_fire.py")
+    cold = lint_paths([fixture], all_rules(), root=FIXTURES,
+                      index_cache=cache)
+    warm = lint_paths([fixture], all_rules(), root=FIXTURES,
+                      index_cache=cache)
+    assert [f.to_dict() for f in warm] == [f.to_dict() for f in cold]
+    assert len(cold) == 2
 
 
 # ------------------------------------------------------------ suppressions
@@ -138,8 +270,32 @@ def test_baseline_prune(tmp_path):
 def test_cli_list_rules(capsys):
     assert main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for code in ("GL001", "GL006"):
+    for code in ("GL001", "GL006", "GL012.inter", "GL013.inter",
+                 "GL009.inter", "GL017"):
         assert code in out
+
+
+def test_cli_explain_prints_chain(capsys):
+    rc = main([os.path.join(FIXTURES, "gl012_inter_fire.py"),
+               "--no-baseline", "--explain"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "    | " in out
+    assert "blocks: time.sleep" in out
+
+
+def test_cli_json_chain_field(capsys):
+    rc = main([os.path.join(FIXTURES, "gl013_inter_fire.py"),
+               "--no-baseline", "--json"])
+    assert rc == 1
+    data = json.loads(capsys.readouterr().out)
+    assert len(data["new"]) == 3
+    assert all(f["chain"] for f in data["new"])
+    rc = main([os.path.join(FIXTURES, "gl002_fire.py"),
+               "--no-baseline", "--json"])
+    assert rc == 1
+    data = json.loads(capsys.readouterr().out)
+    assert all(f["chain"] == [] for f in data["new"])  # per-file layer
 
 
 def test_cli_json_output(capsys):
