@@ -284,6 +284,9 @@ class ClientProxy:
              "from ray_tpu.client import _client_host_main; "
              "_client_host_main()"],
             env=env, stdout=subprocess.PIPE, text=True)
+        # reap exited client hosts so the list tracks live processes
+        # only (it otherwise grows by one per connect, forever)
+        self._procs = [p for p in self._procs if p.poll() is None]
         self._procs.append(proc)
         deadline = time.monotonic() + 60
         addr = None

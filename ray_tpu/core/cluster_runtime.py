@@ -295,6 +295,12 @@ class ClusterRuntime:
         # in-flight submission acks: [deadline, future, resend_fn,
         # fail_fn]; guarded_by(_lock)
         self._pending_acks: list = []
+        # task lifecycle ledger outbox (SUBMITTED/LEASED/RETRIED
+        # transitions from this owner), drained to the head's
+        # task_events lane by the submit sweeper. Capped with drops
+        # counted — a head outage must not grow this without bound.
+        self._ledger_buf: list = []  # guarded_by(_lock)
+        self._ledger_drops = 0  # guarded_by(_lock)
         # gc-driven oneways (frees/borrow releases) flushed by the sweeper
         from collections import deque as _deque
 
@@ -1320,6 +1326,10 @@ class ClusterRuntime:
                 try:
                     spec.attempt += 1
                     spec.spillback_count = 0
+                    self._ledger_event(
+                        spec.task_id, spec.name, "RETRIED",
+                        trace=spec.trace,
+                        detail=f"attempt {spec.attempt}")
                     self.client.call(self.nodelet_address, "schedule_task",
                                      {"spec": dataclass_dict(spec)}, timeout=30,
                                      retries=2)
@@ -1782,6 +1792,10 @@ class ClusterRuntime:
             if streaming:
                 self._streams[spec.task_id] = _StreamState(oids[0].binary())
         self._pin_task_args(spec.task_id, ref_oids)
+        # ledger SUBMITTED: the first transition of the task state
+        # machine, stamped at the owner before any routing decision
+        self._ledger_event(spec.task_id, spec.name, "SUBMITTED",
+                           trace=spec.trace)
         # arg locality: prefer the node already holding the largest args
         # (reference: LocalityAwareLeasePolicy, core_worker/lease_policy.h:58)
         locality = (None if pg_id is not None
@@ -2009,10 +2023,23 @@ class ClusterRuntime:
                     key=lambda le: len(le.inflight), default=None)
             if lease is None:
                 pending.append(spec)
+                # ledger QUEUED: parked CLIENT-side waiting for a lease
+                # grant — the verdict carries the resource request so
+                # `explain` can compute per-node feasibility at the head
+                self._ledger_event(
+                    spec.task_id, spec.name, "QUEUED", trace=spec.trace,
+                    verdict={"decision": "driver-pending-lease",
+                             "resources": dict(spec.resources),
+                             "constraint": "no nodelet currently grants "
+                                           "a worker lease for these "
+                                           "resources"})
                 return True
             lease.inflight.add(spec.task_id)
             lease.last_active = time.monotonic()
             self._task_lease[spec.task_id] = (lease, spec)
+        self._ledger_event(spec.task_id, spec.name, "LEASED",
+                           trace=spec.trace,
+                           detail=f"pipelined onto lease at {lease.address}")
         self._queue_leased_push(lease, spec)
         return True
 
@@ -2044,6 +2071,11 @@ class ClusterRuntime:
                 self._task_lease[spec.task_id] = (lease, spec)
             lease.last_active = time.monotonic()
         for spec in specs:
+            # QUEUED (driver-pending) -> LEASED on the refill path
+            self._ledger_event(spec.task_id, spec.name, "LEASED",
+                               trace=spec.trace,
+                               detail=f"refill onto lease at "
+                                      f"{lease.address}")
             self._queue_leased_push(lease, spec)
 
     def _queue_leased_push(self, lease: _HeldLease, spec: TaskSpec):
@@ -2203,6 +2235,7 @@ class ClusterRuntime:
         while not self._shutdown_flag:
             time.sleep(0.25)
             self._flush_deferred_sends()
+            self._flush_ledger_events()
             now = time.monotonic()
             resend, fail, stale = [], [], []
             with self._lock:
@@ -2449,6 +2482,8 @@ class ClusterRuntime:
         # shrink to the remaining budget, so opt-in retries never hold
         # the caller past the window a single delivery attempt gets
         deadline = time.monotonic() + _ack_timeout()
+        self._ledger_event(task_id, mname, "SUBMITTED", kind="ACTOR_TASK",
+                           trace=msg.get("trace"))
         for attempt in range(tries):
             try:
                 addr = self._resolve_actor(ab)
@@ -2534,6 +2569,8 @@ class ClusterRuntime:
         # worker enqueues a frame's calls in order from one dispatch.
         self._submit_batcher.append(("actor_calls", addr),
                                     (msg, ab, task_id, obids))
+        self._ledger_event(task_id, msg["method"], "SUBMITTED",
+                           kind="ACTOR_TASK", trace=msg.get("trace"))
         self._events.record(f"submit:{msg['method']}", "actor_submit",
                             t_submit0, trace=msg.get("trace"))
 
@@ -2630,6 +2667,41 @@ class ClusterRuntime:
             namespace=self.namespace,
         )
 
+    def _ledger_event(self, task_id: bytes, name: str, state: str,
+                      kind: str = "NORMAL_TASK",
+                      trace: dict | None = None,
+                      detail: str | None = None,
+                      verdict: dict | None = None):
+        """Queue one owner-side lifecycle transition for the head task
+        ledger (flushed by the submit sweeper over the task_events
+        oneway lane — the same buffered-batch discipline workers use)."""
+        ev = {"task_id": task_id.hex(), "name": name, "state": state,
+              "type": kind, "trace_id": (trace or {}).get("trace_id", ""),
+              "time": time.time()}
+        if detail:
+            ev["detail"] = detail
+        if verdict is not None:
+            ev["verdict"] = verdict
+        with self._lock:
+            if len(self._ledger_buf) >= 5000:
+                self._ledger_drops += 1
+            else:
+                self._ledger_buf.append(ev)
+
+    def _flush_ledger_events(self):
+        with self._lock:
+            if not self._ledger_buf:
+                return
+            batch, self._ledger_buf = self._ledger_buf, []
+        try:
+            self.client.send_oneway(self.head_address, "task_events",
+                                    {"events": batch})
+        except Exception:  # noqa: BLE001
+            # observability events: drop the batch (counted) rather than
+            # grow an unbounded retry pile on a dead head
+            with self._lock:
+                self._ledger_drops += len(batch)
+
     def _drain_tagged_spans(self) -> list[dict]:
         """Drain the local span buffer, stamped with this process's
         node/proc identity — the ONE implementation of the tagging
@@ -2682,6 +2754,7 @@ class ClusterRuntime:
         except Exception:  # noqa: BLE001
             pass
         self._flush_deferred_sends()  # don't drop queued frees
+        self._flush_ledger_events()  # ship buffered lifecycle events
         # hand leased workers back (the nodelet's TTL would reclaim them,
         # but a clean return keeps the pool warm for the next driver)
         with self._lock:

@@ -19,6 +19,7 @@ import time
 from ray_tpu.core import serialization as ser
 from ray_tpu.core.rpc import RpcClient, RpcServer
 from ray_tpu.core.specs import ActorSpec, NodeInfo
+from ray_tpu.core.task_ledger import TERMINAL_STATES
 
 HEARTBEAT_INTERVAL_S = 0.5
 NODE_DEATH_AFTER_S = 5.0
@@ -89,6 +90,14 @@ class Head:
         from ray_tpu.utils.events import SpanSpill
 
         self._span_spill = SpanSpill(span_spill_dir, span_spill_max_bytes)
+        # task lifecycle ledger (reference: GcsTaskManager's bounded
+        # task-event store behind `ray list tasks` / `ray summary`):
+        # joins the same oneway inflow per task_id into an explicit
+        # state machine with transition history; the flat _task_events
+        # window above stays as the legacy list_tasks view
+        from ray_tpu.core.task_ledger import TaskLedger
+
+        self._ledger = TaskLedger()
         # span-policy plane (head-driven sampling for >10k spans/s):
         # operator policy wins; otherwise an automatic per-producer rate
         # limit kicks in when cluster-wide inflow exceeds the cap
@@ -156,6 +165,10 @@ class Head:
         s.register("task_events", self._h_task_events, oneway=True)
         s.register("span_policy", self._h_span_policy)
         s.register("list_tasks", self._h_list_tasks)
+        s.register("task_ledger", self._h_task_ledger)
+        # slow lane: explain fans out to every alive nodelet under one
+        # shared deadline (the cluster_logs shape) for live queue state
+        s.register("explain_task", self._h_explain_task, slow=True)
         # big payload / fan-out surfaces ride the slow lane so a timeline
         # dump or metrics scrape never starves heartbeats
         s.register("dump_timeline", self._h_dump_timeline, slow=True)
@@ -586,9 +599,13 @@ class Head:
     def _h_task_event(self, msg, frames):
         """Executor-side task lifecycle events (reference:
         TaskEventBuffer -> GcsTaskManager, gcs_task_manager.h:86 —
-        bounded in-memory store feeding the state API)."""
-        with self._lock:
-            self._task_events.append(msg)
+        bounded in-memory store feeding the state API). The flat
+        `list_tasks` window keeps its one-terminal-row-per-attempt
+        shape; intermediate lifecycle states live in the ledger."""
+        if msg.get("state") in TERMINAL_STATES:
+            with self._lock:
+                self._task_events.append(msg)
+        self._ledger.ingest((msg,))
 
     def _ingest_spans(self, spans) -> None:
         """Append flushed spans to the bounded in-memory window, spilling
@@ -624,8 +641,12 @@ class Head:
         task_event_buffer.h periodic flush). Also the span-flush channel:
         the same oneway carries raw TaskEventLog spans for the merged
         cluster timeline."""
-        with self._lock:
-            self._task_events.extend(msg.get("events", ()))
+        events = msg.get("events", ())
+        flat = [e for e in events if e.get("state") in TERMINAL_STATES]
+        if flat:
+            with self._lock:
+                self._task_events.extend(flat)
+        self._ledger.ingest(events)
         self._ingest_spans(msg.get("spans", ()))
 
     def set_span_policy(self, policy: dict | None) -> None:
@@ -663,6 +684,110 @@ class Head:
         with self._lock:
             events = list(self._task_events)[-limit:]
         return {"tasks": events}
+
+    def _h_task_ledger(self, msg, frames):
+        """Ledger query: per-state counts + ring stats, one record by
+        task_id prefix, or the last-N record summaries."""
+        out = {"counts": self._ledger.counts(),
+               "stats": self._ledger.stats()}
+        tid = msg.get("task_id")
+        if tid:
+            out["record"] = self._ledger.get(str(tid))
+        limit = int(msg.get("limit", 0))
+        if limit > 0:
+            out["records"] = self._ledger.recent(limit)
+        return out
+
+    def _h_explain_task(self, msg, frames):
+        """`ray_tpu explain <task_id>`: the ledger's view of one task
+        plus, for a task that is not yet terminal, each alive nodelet's
+        live placement explanation (is it queued there, how long, what
+        the last verdict rejected). Fan-out runs under ONE shared
+        deadline; a dead node becomes an `errors` entry, never a
+        failed gather (the profile-capture/cluster_logs shape)."""
+        from ray_tpu.core import task_ledger as tl
+
+        tid = str(msg.get("task_id") or "").lower()
+        timeout = min(float(msg.get("timeout", 10.0)), 60.0)
+        rec = self._ledger.get(tid)
+        out: dict = {"task_id": tid, "record": rec, "errors": {}}
+        if rec is not None:
+            out["waterfall"] = tl.waterfall(rec)
+            if rec.get("verdict") is not None:
+                out["verdict"] = rec["verdict"]
+        if rec is not None and rec.get("state") in tl.TERMINAL_STATES:
+            return out
+        with self._lock:
+            targets = [(n.node_id.hex()[:12], n.address)
+                       for n in self._nodes.values() if n.alive]
+        results = self.client.call_gather(
+            [(addr, "explain_task", {"task_id": tid})
+             for _, addr in targets], timeout=timeout)
+        nodes = {}
+        for (nid, _), r in zip(targets, results):
+            if r is None:
+                out["errors"][nid] = "explain_task failed or timed out"
+            else:
+                nodes[nid] = r
+        out["nodes"] = nodes
+        # a task parked DRIVER-side waiting for a lease grant is in no
+        # nodelet queue, so no fan-out target can explain it — but its
+        # QUEUED verdict carries the resource request, and the head owns
+        # the authoritative node table: compute the feasibility verdict
+        # here (same reason strings as the nodelet's _consider_nodes)
+        if (rec is not None
+                and not any(r.get("queued") for r in nodes.values())):
+            req = (rec.get("verdict") or {}).get("resources")
+            if req:
+                considered, constraint = self._consider_nodes(req)
+                v = dict(rec.get("verdict") or {})
+                v["nodes_considered"] = considered
+                if constraint:
+                    v["constraint"] = constraint
+                out["verdict"] = v
+        return out
+
+    def _consider_nodes(self, req: dict) -> tuple[list, str | None]:
+        """Per-node feasibility for a resource request against the
+        head's own node table — (entries, constraint), where constraint
+        names the unsatisfiable requirement when NO alive node has the
+        total capacity, None when the request is merely busy-waiting."""
+        with self._lock:
+            view = [(n.node_id, n.alive, dict(n.resources),
+                     dict(self._available.get(n.node_id, {})))
+                    for n in self._nodes.values()]
+        entries = []
+        any_total_fit = False
+        for nid, alive, total, avail in view:
+            e = {"node_id": nid.hex()[:12], "ok": False}
+            if not alive:
+                e["reason"] = "dead"
+                entries.append(e)
+                continue
+            short = {r: q for r, q in req.items()
+                     if total.get(r, 0.0) < q}
+            if short:
+                e["reason"] = (
+                    f"insufficient total capacity: needs {short}, node "
+                    f"has {({r: total.get(r, 0.0) for r in short})}")
+                entries.append(e)
+                continue
+            any_total_fit = True
+            busy = {r: q for r, q in req.items()
+                    if avail.get(r, 0.0) < q}
+            if busy:
+                e["reason"] = (
+                    f"busy: needs {busy}, only "
+                    f"{({r: avail.get(r, 0.0) for r in busy})} available")
+            else:
+                e["ok"] = True
+                e["reason"] = "feasible"
+            entries.append(e)
+        constraint = None
+        if not any_total_fit:
+            constraint = (f"no node in the cluster has total capacity "
+                          f"for resources {req}")
+        return entries, constraint
 
     def _h_dump_timeline(self, msg, frames):
         """Raw cluster-wide span buffer (reference: `ray timeline` over

@@ -209,6 +209,18 @@ class Nodelet:
             "object_store_evictions", "Cumulative store evictions "
             "(gauge mirror of the store's counter, set at scrape)",
             registry=self._metrics_registry)
+        self._m_queue_wait = Histogram(
+            "task_queue_wait_seconds",
+            "Time tasks spend in this nodelet's dispatch queue "
+            "(enqueue to dispatch)",
+            boundaries=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30, 120),
+            registry=self._metrics_registry)
+        # task lifecycle ledger outbox: scheduler-side QUEUED/DISPATCHED/
+        # SCHEDULED/FAILED transitions buffered here and flushed to the
+        # head's task_events lane by the heartbeat loop. Capped with
+        # drops counted — a head outage must not grow this without bound.
+        self._ledger_buf: list[dict] = []  # guarded_by(_lock)
+        self._ledger_drops = 0  # guarded_by(_lock)
 
         s = self.server
         s.register("schedule_task", self._h_schedule_task)
@@ -246,6 +258,7 @@ class Nodelet:
         # file I/O — slow lane so a log sweep never starves dispatch
         s.register("log_query", self._h_log_query, slow=True)
         s.register("node_stats", self._h_node_stats)
+        s.register("explain_task", self._h_explain_task)
         s.register("ping", lambda m, f: "pong")
 
         self._threads = [
@@ -463,6 +476,41 @@ class Nodelet:
                 # anti-entropy refresh
                 last_sent = snapshot
                 beats_since_full = 0
+            self._flush_ledger_events()
+
+    def _ledger_event(self, spec: TaskSpec, state: str,
+                      verdict: dict | None = None,
+                      detail: str | None = None):
+        """Queue one scheduler-side lifecycle transition for the head
+        ledger (flushed by the heartbeat loop over the task_events
+        oneway lane)."""
+        ev = {"task_id": spec.task_id.hex(), "name": spec.name,
+              "state": state, "type": "NORMAL_TASK",
+              "trace_id": (spec.trace or {}).get("trace_id", ""),
+              "node_id": self.node_id.hex(), "time": time.time()}
+        if verdict is not None:
+            ev["verdict"] = verdict
+        if detail:
+            ev["detail"] = detail
+        with self._lock:
+            if len(self._ledger_buf) >= 2000:
+                self._ledger_drops += 1
+            else:
+                self._ledger_buf.append(ev)
+
+    def _flush_ledger_events(self):
+        with self._lock:
+            if not self._ledger_buf:
+                return
+            batch, self._ledger_buf = self._ledger_buf, []
+        try:
+            self.client.send_oneway(self.head_address, "task_events",
+                                    {"events": batch})
+        except Exception:
+            # local send failure: these are observability events — drop
+            # the batch (counted) rather than grow an unbounded retry pile
+            with self._lock:
+                self._ledger_drops += len(batch)
 
     # ------------------------------------------------------------ workers
 
@@ -882,6 +930,7 @@ class Nodelet:
 
     def _fail_task(self, spec: TaskSpec, cause: str,
                    retryable: bool = False):
+        self._ledger_event(spec, "FAILED", detail=cause)
         try:
             self.client.send_oneway(spec.owner, "task_done", {
                 "task_id": spec.task_id,
@@ -919,18 +968,39 @@ class Nodelet:
                 self._queue.append(spec)
                 self._add_queued_demand(spec, +1)
                 self._enqueue_time[spec.task_id] = time.monotonic()
+            self._ledger_event(spec, "QUEUED", verdict={
+                "decision": "local", "node_id": self.node_id.hex()[:12]})
             self._dispatch_wake.set()
             return {"queued": "local"}
         if target is None:
+            # scheduler decision tracing: an infeasible-wait verdict
+            # records WHY — which nodes were considered and which
+            # constraint failed — so `ray_tpu explain` can name the
+            # unsatisfiable requirement instead of showing a stuck task
+            from ray_tpu.util.scheduling_strategies import (
+                split_soft_selector as _sss2,
+            )
+
+            sel2, _ = _sss2(spec.label_selector)
+            considered, constraint = self._consider_nodes(
+                self._task_req(spec), sel2 or None)
             with self._lock:  # queue anyway; resources may appear
                 self._queue.append(spec)
                 self._add_queued_demand(spec, +1)
                 self._enqueue_time[spec.task_id] = time.monotonic()
+            self._ledger_event(spec, "QUEUED", verdict={
+                "decision": "infeasible-wait",
+                "node_id": self.node_id.hex()[:12],
+                "constraint": constraint or "waiting for resources",
+                "nodes_considered": considered,
+                "spillback_count": spec.spillback_count})
             self._dispatch_wake.set()
             return {"queued": "infeasible-wait"}
         # spillback (reference: normal_task_submitter.cc:451 retry at
         # the raylet the scheduler pointed to)
         spec.spillback_count += 1
+        self._ledger_event(spec, "SCHEDULED",
+                           detail=f"spillback to {target}")
         self.client.call(target, "schedule_task",
                          {"spec": dataclass_dict(spec)}, timeout=30)
         return {"queued": "spilled"}
@@ -1035,6 +1105,103 @@ class Nodelet:
             if best_free is None or free > best_free:
                 best, best_free = n, free
         return best
+
+    def _consider_nodes(self, req: dict, selector: dict | None):
+        """Per-node feasibility table for scheduler decision tracing:
+        why each cluster node can or cannot take this request right
+        now. Returns (entries, constraint) — `constraint` names the
+        unsatisfiable requirement when NO node can EVER satisfy it
+        (label mismatch everywhere / total capacity short everywhere),
+        None when the request is merely waiting on busy resources."""
+        from ray_tpu.util.scheduling_strategies import labels_match
+
+        view = self._cluster_view_cached()
+        entries = []
+        any_label_match = False
+        any_total_fit = False
+        for n in view:
+            nid = n["node_id"]
+            e = {"node_id": (nid.hex() if hasattr(nid, "hex")
+                             else str(nid))[:12], "ok": False}
+            if not n.get("alive", True):
+                e["reason"] = "dead"
+                entries.append(e)
+                continue
+            if selector and not labels_match(n.get("labels", {}), selector):
+                e["reason"] = (f"label selector {selector} does not match "
+                               f"node labels")
+                entries.append(e)
+                continue
+            any_label_match = True
+            total = n.get("resources", {})
+            avail = n.get("available", {})
+            short = {r: q for r, q in req.items()
+                     if total.get(r, 0.0) < q}
+            if short:
+                e["reason"] = (
+                    f"insufficient total capacity: needs {short}, node "
+                    f"has {({r: total.get(r, 0.0) for r in short})}")
+                entries.append(e)
+                continue
+            any_total_fit = True
+            busy = {r: q for r, q in req.items()
+                    if avail.get(r, 0.0) < q}
+            if busy:
+                e["reason"] = (
+                    f"busy: needs {busy}, only "
+                    f"{({r: avail.get(r, 0.0) for r in busy})} available")
+            else:
+                e["ok"] = True
+                e["reason"] = "feasible"
+            entries.append(e)
+        constraint = None
+        if selector and not any_label_match:
+            constraint = (f"no alive node matches hard label selector "
+                          f"{selector}")
+        elif not any_total_fit:
+            constraint = (f"no node in the cluster has total capacity "
+                          f"for resources {req}")
+        return entries, constraint
+
+    def _h_explain_task(self, msg, frames):
+        """Live half of `ray_tpu explain`: is the task queued on THIS
+        node, how long has it waited, and what does placement look like
+        against the current cluster view. The head fans this out to
+        every alive nodelet under one shared deadline."""
+        p = str(msg.get("task_id") or "").lower()
+        with self._lock:
+            qspecs = list(self._queue)
+            enq = dict(self._enqueue_time)
+            avail = dict(self._available)
+        spec = pos = None
+        for i, s in enumerate(qspecs):
+            if p and s.task_id.hex().startswith(p):
+                spec, pos = s, i
+                break
+        out = {"node_id": self.node_id.hex()[:12],
+               "queued": spec is not None, "queue_len": len(qspecs)}
+        if spec is None:
+            return out
+        t0 = enq.get(spec.task_id)
+        out.update({
+            "name": spec.name,
+            "queue_position": pos,
+            "waited_s": (round(time.monotonic() - t0, 3)
+                         if t0 is not None else None),
+            "resources": spec.resources,
+            "label_selector": spec.label_selector,
+            "available": avail,
+            "spillback_count": spec.spillback_count,
+        })
+        from ray_tpu.util.scheduling_strategies import split_soft_selector
+
+        sel, _ = split_soft_selector(spec.label_selector)
+        considered, constraint = self._consider_nodes(
+            self._task_req(spec), sel or None)
+        out["nodes_considered"] = considered
+        if constraint:
+            out["constraint"] = constraint
+        return out
 
     def _maybe_respill_locked(self, spec: TaskSpec):
         """A task that has waited locally while the cluster changed can
@@ -1260,7 +1427,13 @@ class Nodelet:
                                 free[r] = free.get(r, 0.0) - q
                         self._queue.popleft()
                         self._add_queued_demand(spec, -1)
-                        self._enqueue_time.pop(spec.task_id, None)
+                        t_enq = self._enqueue_time.pop(spec.task_id, None)
+                        if t_enq is not None:
+                            # queue-wait attribution: enqueue→dispatch
+                            # (feeds the task-queue-stall watchtower rule)
+                            self._m_queue_wait.observe(
+                                time.monotonic() - t_enq)
+                        self._ledger_event(spec, "DISPATCHED")
                 if reject is not None:
                     self._fail_task(
                         reject,
@@ -1269,6 +1442,8 @@ class Nodelet:
                         f"its placement-group bundle reservation")
                     continue
                 if respill is not None:
+                    self._ledger_event(spec, "SCHEDULED",
+                                       detail=f"respill to {respill}")
                     threading.Thread(target=self._send_respill,
                                      args=(spec, respill),
                                      daemon=True).start()

@@ -1,0 +1,240 @@
+"""Task lifecycle ledger — the fifth observability pillar.
+
+Every task / actor call moves through an explicit state machine
+
+    SUBMITTED -> QUEUED -> LEASED/SCHEDULED/DISPATCHED -> RUNNING
+              -> FINISHED | FAILED | RETRIED(-> QUEUED ...)
+
+with per-transition epoch-anchored timestamps recorded at the driver
+submit path, the nodelet lease/scheduling path, and the worker exec
+loop (reference: the GCS task-event store behind `ray list tasks`,
+gcs_task_manager.h:86 — a bounded in-memory ledger fed by executor
+TaskEventBuffer flushes). All producers ride the existing
+``task_events`` oneway lane; the head routes each event into both the
+flat ``_task_events`` window (the legacy ``list_tasks`` view) and this
+ledger, which JOINS events per task_id and keeps the transition
+history.
+
+Bounding discipline: a fixed-capacity ring of per-task records
+(least-recently-updated evicted first, so live tasks survive a burst
+of finished ones), each record capping its transition list, with
+evicted records spilled to bounded on-disk JSONL (the SpanSpill
+shape) so a post-mortem ``explain`` can still find a task that
+scrolled out of memory. Every bound counts what it drops.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from ray_tpu.utils.events import SpanSpill
+
+# Canonical lifecycle states. DISPATCHED covers the nodelet handing a
+# task to a local worker; LEASED covers the direct-push lease path
+# (the submitter bypasses per-task scheduling); SCHEDULED covers a
+# spillback hop to another node.
+STATES = ("SUBMITTED", "QUEUED", "LEASED", "SCHEDULED", "DISPATCHED",
+          "RUNNING", "FINISHED", "FAILED", "RETRIED")
+TERMINAL_STATES = frozenset(("FINISHED", "FAILED"))
+_STATE_SET = frozenset(STATES)
+
+
+def waterfall(record: dict) -> dict:
+    """Pure phase breakdown of one ledger record: per-edge durations
+    between consecutive transitions plus the named phases operators ask
+    about ("why slow": queue / dispatch / exec). Times are epoch
+    seconds; output durations are milliseconds."""
+    # producers flush on independent cadences (driver sweeper, nodelet
+    # heartbeat, worker event loop), so arrival order is not time
+    # order — the waterfall is over the recorded timestamps
+    trans = sorted(record.get("transitions") or [],
+                   key=lambda tr: tr.get("t", 0.0))
+    phases = []
+    for a, b in zip(trans, trans[1:]):
+        phases.append({
+            "phase": f"{a['state']}→{b['state']}",
+            "ms": round(max(0.0, (b["t"] - a["t"]) * 1e3), 3),
+        })
+    by_state: dict[str, float] = {}
+    for tr in trans:
+        # first time each state was entered (retries re-enter QUEUED;
+        # the waterfall describes the LAST attempt, so keep latest)
+        by_state[tr["state"]] = tr["t"]
+    out = {"phases": phases, "states": sorted(by_state)}
+    if trans:
+        out["total_ms"] = round(
+            max(0.0, (trans[-1]["t"] - trans[0]["t"]) * 1e3), 3)
+    # queue wait starts at the FIRST queueing of the last attempt (a
+    # spillback can re-queue the task on another node mid-wait; the
+    # hop is still time spent waiting for placement) and ends at the
+    # hand-off to a worker. SCHEDULED never ends it — it is a
+    # pre-queue hop, and cross-process clock jitter can stamp it a
+    # hair after the target's QUEUED.
+    last_retry = max((tr["t"] for tr in trans if tr["state"] == "RETRIED"),
+                     default=None)
+    q = min((tr["t"] for tr in trans
+             if tr["state"] == "QUEUED"
+             and (last_retry is None or tr["t"] >= last_retry)),
+            default=None)
+    start = min((by_state[s] for s in ("DISPATCHED", "LEASED", "RUNNING")
+                 if s in by_state and (q is None or by_state[s] >= q)),
+                default=None)
+    if q is not None and start is not None:
+        out["queue_ms"] = round(max(0.0, (start - q) * 1e3), 3)
+    run = by_state.get("RUNNING")
+    end = min((by_state[s] for s in TERMINAL_STATES if s in by_state),
+              default=None)
+    if run is not None and end is not None:
+        out["exec_ms"] = round(max(0.0, (end - run) * 1e3), 3)
+    elif end is not None and record.get("duration_ms") is not None:
+        # executor-reported duration covers RUNNING when the worker
+        # only flushed the terminal event (pre-ledger producers)
+        out["exec_ms"] = record["duration_ms"]
+    return out
+
+
+class TaskLedger:
+    """Bounded per-task lifecycle store living on the head.
+
+    Thread-safe behind a private lock; the spill write happens outside
+    it (SpanSpill has its own lock) so disk latency never stalls the
+    task_events ingest handler.
+    """
+
+    def __init__(self, capacity: int = 10_000, max_transitions: int = 32,
+                 spill_dir: str | None = None,
+                 spill_max_bytes: int = 32 << 20):
+        from ray_tpu.util.metrics import Counter
+
+        self._lock = threading.Lock()
+        self._capacity = int(capacity)
+        self._max_transitions = int(max_transitions)
+        # task_id hex -> record; least-recently-UPDATED first, so a
+        # burst of short tasks evicts finished history, not live tasks
+        self._records: OrderedDict[str, dict] = OrderedDict()
+        self._spill = SpanSpill(spill_dir, spill_max_bytes)
+        self.events_total = 0  # guarded_by(_lock)
+        self.dropped_transitions_total = 0  # guarded_by(_lock)
+        self.spilled_records_total = 0  # guarded_by(_lock)
+        self._m_events = Counter(
+            "task_ledger_events_total",
+            "Lifecycle events ingested into the head task ledger")
+        self._m_dropped = Counter(
+            "task_ledger_dropped_total",
+            "Ledger transitions dropped by the per-record cap")
+
+    # ------------------------------------------------------------ ingest
+
+    def ingest(self, events) -> None:
+        """Route a task_events batch into the ledger. Events without a
+        task_id or with an unknown state are ignored (the flat window
+        still keeps them); unknown extra keys ride into the record's
+        latest fields."""
+        if not events:
+            return
+        evicted: list[dict] = []
+        n_events = n_dropped = 0
+        with self._lock:
+            for ev in events:
+                tid = ev.get("task_id")
+                state = ev.get("state")
+                if not tid or state not in _STATE_SET:
+                    continue
+                n_events += 1
+                rec = self._records.get(tid)
+                if rec is None:
+                    rec = {"task_id": tid, "name": "", "type": "",
+                           "trace_id": "", "state": state,
+                           "transitions": [], "dropped_transitions": 0}
+                    self._records[tid] = rec
+                else:
+                    self._records.move_to_end(tid)
+                for k in ("name", "type", "trace_id", "node_id",
+                          "worker_id", "duration_ms", "error"):
+                    v = ev.get(k)
+                    if v not in (None, ""):
+                        rec[k] = v
+                verdict = ev.get("verdict")
+                if verdict is not None:
+                    rec["verdict"] = verdict
+                rec["state"] = state
+                tr = {"state": state, "t": float(ev.get("time") or 0.0)}
+                for k in ("node_id", "worker_id", "detail"):
+                    v = ev.get(k)
+                    if v not in (None, ""):
+                        tr[k] = v
+                if len(rec["transitions"]) < self._max_transitions:
+                    rec["transitions"].append(tr)
+                else:
+                    rec["dropped_transitions"] += 1
+                    n_dropped += 1
+                    # keep the terminal verdict visible even when the
+                    # history cap was blown by retries
+                    rec["transitions"][-1] = tr
+            while len(self._records) > self._capacity:
+                _, old = self._records.popitem(last=False)
+                evicted.append(old)
+            self.events_total += n_events
+            self.dropped_transitions_total += n_dropped
+            self.spilled_records_total += len(evicted)
+        if n_events:
+            self._m_events.inc(n_events)
+        if n_dropped:
+            self._m_dropped.inc(n_dropped)
+        if evicted:
+            self._spill.append(evicted)
+
+    # ------------------------------------------------------------ queries
+
+    def counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        with self._lock:
+            for rec in self._records.values():
+                out[rec["state"]] = out.get(rec["state"], 0) + 1
+        return out
+
+    def get(self, task_id_prefix: str) -> dict | None:
+        """Find one record by full task_id hex or unique-enough prefix.
+        Memory first, then the on-disk spill (latest match wins there —
+        a retried task may have spilled more than once)."""
+        p = (task_id_prefix or "").lower()
+        if not p:
+            return None
+        with self._lock:
+            rec = self._records.get(p)
+            if rec is None:
+                for tid, r in self._records.items():
+                    if tid.startswith(p):
+                        rec = r
+                        break
+            if rec is not None:
+                return _copy_record(rec)
+        hit = None
+        for r in self._spill.read():
+            tid = r.get("task_id") or ""
+            if tid == p or tid.startswith(p):
+                hit = r
+        return hit
+
+    def recent(self, limit: int = 100) -> list[dict]:
+        with self._lock:
+            recs = list(self._records.values())[-int(limit):]
+            return [_copy_record(r) for r in recs]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "records": len(self._records),
+                "capacity": self._capacity,
+                "events_total": self.events_total,
+                "dropped_transitions_total": self.dropped_transitions_total,
+                "spilled_records_total": self.spilled_records_total,
+                "spill_rotated_total": self._spill.rotated_total,
+            }
+
+
+def _copy_record(rec: dict) -> dict:
+    out = dict(rec)
+    out["transitions"] = [dict(t) for t in rec["transitions"]]
+    return out

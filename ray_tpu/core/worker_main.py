@@ -430,6 +430,10 @@ class WorkerRuntime(ClusterRuntime):
         self._ctx.task_name = spec.name
         self._ctx.task_owner = spec.owner
         t_start = time.monotonic()
+        # ledger RUNNING transition: the queue→exec boundary seen from
+        # the worker (one buffered dict append — noise-level cost)
+        self._report_task_event(spec.task_id, spec.name, "RUNNING",
+                                t_start, "NORMAL_TASK")
         # per-task CPU attribution: thread_time deltas on the executing
         # thread feed core_task_cpu_seconds_total{kind} + the cpu_stats
         # table (two clock reads per task — noise-level cost)
@@ -605,6 +609,9 @@ class WorkerRuntime(ClusterRuntime):
             # — same boundary as CPU attribution's dispatch sliver)
             self._ctx.task_name = label
             self._ctx.task_owner = owner
+            if task_id:
+                self._report_task_event(task_id, label, "RUNNING",
+                                        t_start, "ACTOR_TASK")
             try:
                 a, kw = self._decode_args(msg["args"], msg["kwargs"])
                 fn = getattr(self._actor_instance, mname)
@@ -712,8 +719,17 @@ class WorkerRuntime(ClusterRuntime):
         stop = threading.Event()
         self._dag_loops[loop_id] = stop
 
+        # per-stage attribution: SPSC channels deliver executions in
+        # seq order through every stage, so a local counter IS the
+        # execution's seq — each stage's span joins the driver's
+        # dag.execute span under one synthetic trace_id per execution
+        # (what `ray_tpu critpath` chains into the slow-stage answer)
+        prefix, _, stage = loop_id.rpartition("_")
+        span_name = f"dag.{method}:{stage}"
+
         def run():
             fn = getattr(self._actor_instance, method)
+            n_exec = 0
             while not stop.is_set():
                 try:
                     # short poll on the FIRST input (checks `stop`); once
@@ -729,6 +745,8 @@ class WorkerRuntime(ClusterRuntime):
                     args = [first] + [c.get(timeout=60) for c in ins[1:]]
                 except Exception:  # noqa: BLE001
                     return
+                dag_trace = {"trace_id": f"dag:{prefix}:{n_exec}"}
+                n_exec += 1
                 # an upstream stage's error marker passes through
                 # UNCHANGED (it consumes one slot per stage, so sequence
                 # numbers stay aligned and the driver re-raises the
@@ -741,10 +759,14 @@ class WorkerRuntime(ClusterRuntime):
                         out.put(marker)
                         continue
                     if getattr(self, "_serial_actor", False):
-                        with self._instance_lock:
+                        with self._instance_lock, \
+                                self._events.span(span_name, "dag",
+                                                  trace=dag_trace):
                             result = fn(*args)
                     else:
-                        result = fn(*args)
+                        with self._events.span(span_name, "dag",
+                                               trace=dag_trace):
+                            result = fn(*args)
                     out.put(result)
                 except Exception as e:  # noqa: BLE001
                     # ship the same TaskError the eager path would raise

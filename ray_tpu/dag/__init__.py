@@ -329,7 +329,9 @@ class CompiledDAG:
             self._write_cond.notify_all()
         ref = CompiledDAGRef(self, seq)
         _count_execution(fallback=self._broken)
-        self._rt._events.record(f"dag.execute:{seq}", "dag", t0)
+        self._rt._events.record(
+            f"dag.execute:{seq}", "dag", t0,
+            trace={"trace_id": f"dag:{self._loop_prefix}:{seq}"})
         return ref
 
     def _fetch(self, seq: int, timeout):

@@ -20,5 +20,6 @@ from ray_tpu.devtools.rules import (  # noqa: F401
     sequential_rpc,
     spmd_nondeterminism,
     store_refcount,
+    unbounded_accumulator,
     wallclock_duration,
 )

@@ -1,0 +1,129 @@
+"""GL018: unbounded container growth in RPC handlers / daemon loops.
+
+Long-lived processes (head, nodelet, worker, driver runtime) accumulate
+state in RPC handlers (``_h_*`` methods) and daemon loops (``*_loop``
+methods) that run for the lifetime of the cluster. A ``self.X.append``
+in such a method with NO bounding discipline anywhere in the class is a
+slow leak: it grows monotonically with traffic until the process OOMs —
+the classic shape behind "the head died after three days".
+
+Bounding discipline, recognized anywhere in the same class:
+
+- the attribute is constructed with a ``maxlen=`` keyword (a bounded
+  ``deque``);
+- something consumes it: ``.pop/.popleft/.popitem/.clear/.discard/
+  .remove`` on the attribute, or ``del self.X[...]``;
+- the attribute is REASSIGNED outside ``__init__`` (the drain-by-
+  reassignment idiom: ``batch, self.X = self.X, []``) or its contents
+  replaced via slice assignment (``self.X[:] = ...``).
+
+Caps enforced by a length check before the append count as discipline
+only when paired with one of the above on the overflow path (drop or
+drain) — a bare length check without a consumer still never shrinks.
+Scope is deliberately narrow: only ``self``-attribute containers, only
+``append/appendleft/add/insert/extend`` calls, only inside handler or
+loop methods. Dict subscript writes are out of scope (GL013's keyed-
+state territory)."""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools.context import ModuleContext, qualname
+from ray_tpu.devtools.registry import Rule, register
+
+_GROW = frozenset(("append", "appendleft", "add", "insert", "extend"))
+_SHRINK = frozenset(("pop", "popleft", "popitem", "clear", "discard",
+                     "remove"))
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """'X' when node is exactly ``self.X``, else None."""
+    qn = qualname(node)
+    if qn and qn.startswith("self.") and qn.count(".") == 1:
+        return qn[len("self."):]
+    return None
+
+
+def _is_hot_method(name: str) -> bool:
+    return name.startswith("_h_") or name.endswith("_loop")
+
+
+@register
+class UnboundedAccumulatorRule(Rule):
+    name = "unbounded-accumulator"
+    code = "GL018"
+    description = ("container attribute grown in an RPC handler or "
+                   "daemon loop with no cap/trim/drain discipline "
+                   "anywhere in the class — a slow leak")
+    invariant = ("every container a long-lived process appends to on "
+                 "a traffic-driven path is bounded: maxlen, a "
+                 "consumer that pops/clears, or drain-by-reassignment")
+    interests = ("ClassDef",)
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        methods = [n for n in node.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        if not methods:
+            return
+        disciplined: set[str] = set()
+        # (attr, call node, method name) growth sites on hot paths
+        growth: list[tuple[str, ast.Call, str]] = []
+
+        for meth in methods:
+            hot = _is_hot_method(meth.name)
+            for sub in ast.walk(meth):
+                if isinstance(sub, ast.Call) and isinstance(
+                        sub.func, ast.Attribute):
+                    attr = _self_attr(sub.func.value)
+                    if attr is None:
+                        continue
+                    if sub.func.attr in _SHRINK:
+                        disciplined.add(attr)
+                    elif hot and sub.func.attr in _GROW:
+                        growth.append((attr, sub, meth.name))
+                elif isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    targets = (sub.targets
+                               if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                    for tgt in targets:
+                        # tuple unpack: batch, self.X = self.X, []
+                        elts = (tgt.elts if isinstance(
+                            tgt, (ast.Tuple, ast.List)) else [tgt])
+                        for e in elts:
+                            attr = _self_attr(e)
+                            if attr is not None:
+                                if meth.name != "__init__":
+                                    disciplined.add(attr)
+                                elif self._bounded_ctor(sub.value):
+                                    disciplined.add(attr)
+                            elif isinstance(e, ast.Subscript):
+                                # self.X[:] = ... / self.X[i] = ...
+                                attr = _self_attr(e.value)
+                                if attr is not None:
+                                    disciplined.add(attr)
+                elif isinstance(sub, ast.Delete):
+                    for tgt in sub.targets:
+                        base = (tgt.value if isinstance(
+                            tgt, ast.Subscript) else tgt)
+                        attr = _self_attr(base)
+                        if attr is not None:
+                            disciplined.add(attr)
+
+        for attr, call, meth_name in growth:
+            if attr in disciplined:
+                continue
+            kind = ("RPC handler" if meth_name.startswith("_h_")
+                    else "daemon loop")
+            ctx.report(self, call,
+                       f"self.{attr}.{call.func.attr}() in {kind} "
+                       f"{meth_name}() with no bounding discipline in "
+                       f"class {node.name} — grows with traffic until "
+                       "OOM; bound it (deque(maxlen=...), a consumer "
+                       "that pops/clears, or drain-by-reassignment)")
+
+    @staticmethod
+    def _bounded_ctor(value: ast.AST | None) -> bool:
+        return isinstance(value, ast.Call) and any(
+            kw.arg == "maxlen" for kw in value.keywords)

@@ -7,6 +7,10 @@ Reference parity: python/ray/scripts/scripts.py — `ray start --head`,
       [--num-cpus N] [--num-tpus N] [--resources JSON] [--block]
   python -m ray_tpu.scripts.cli start --address HOST:PORT [...]
   python -m ray_tpu.scripts.cli status  --address HOST:PORT
+  python -m ray_tpu.scripts.cli summary --address HOST:PORT [--json]
+  python -m ray_tpu.scripts.cli explain TASK_ID --address HOST:PORT
+  python -m ray_tpu.scripts.cli critpath --address HOST:PORT
+      [--trace-id T] [--json]
   python -m ray_tpu.scripts.cli list {actors|nodes|pgs} --address ...
   python -m ray_tpu.scripts.cli timeline --address HOST:PORT -o out.json
   python -m ray_tpu.scripts.cli metrics  --address HOST:PORT
@@ -126,6 +130,138 @@ def cmd_status(args):
     for r, q in sorted(s["resources_total"].items()):
         a = s["resources_available"].get(r, 0.0)
         print(f"  {r}: {a:g}/{q:g} available")
+    return 0
+
+
+def cmd_summary(args):
+    """One-screen cluster overview: nodes, actors by state, ledger
+    task counts by lifecycle state, object bytes + stranded, firing
+    alerts (reference: `ray summary`)."""
+    from ray_tpu.util import state
+
+    s = state.cluster_summary(address=args.address)
+    if args.json:
+        print(json.dumps(s, indent=2, default=str))
+        return 0
+    c = s.get("cluster") or {}
+    if c:
+        print(f"nodes:  {c['nodes_alive']} alive, {c['nodes_dead']} dead")
+        res = " ".join(
+            f"{r}={c['resources_available'].get(r, 0.0):g}/{q:g}"
+            for r, q in sorted(c["resources_total"].items()))
+        print(f"resources (avail/total): {res}")
+    ab = s.get("actors_by_state") or {}
+    print("actors: " + (" ".join(f"{k}={v}" for k, v in sorted(ab.items()))
+                        or "none"))
+    t = s.get("tasks") or {}
+    counts = t.get("counts") or {}
+    print("tasks:  " + (" ".join(f"{k}={v}"
+                                 for k, v in sorted(counts.items()))
+                        or "none"))
+    st = t.get("stats") or {}
+    if st:
+        print(f"ledger: {st.get('records', 0)}/{st.get('capacity', 0)} "
+              f"records, {st.get('events_total', 0)} events, "
+              f"{st.get('dropped_transitions_total', 0)} dropped, "
+              f"{st.get('spilled_records_total', 0)} spilled")
+    o = s.get("objects") or {}
+    if o:
+        print(f"objects: {o['objects_total']} "
+              f"({o['objects_bytes'] / (1 << 20):.1f}MB), "
+              f"stranded {o['stranded_count']} "
+              f"({o['stranded_bytes'] / (1 << 20):.1f}MB)")
+    al = s.get("alerts")
+    if al:
+        print(f"alerts: {len(al)} active")
+        for a in al:
+            print(f"  {a['rule']:<24} {a['severity']:<9} {a['state']}")
+    elif al is not None:
+        print("alerts: none")
+    for name, err in sorted((s.get("errors") or {}).items()):
+        print(f"  UNAVAILABLE {name}: {err}", file=sys.stderr)
+    return 0
+
+
+def cmd_explain(args):
+    """Why is this task pending / why was it slow: the ledger
+    transition waterfall plus the scheduler's placement verdict and
+    each node's live feasibility view."""
+    from ray_tpu.util import state
+
+    r = state.explain_task(args.task_id, address=args.address)
+    if args.json:
+        print(json.dumps(r, indent=2, default=str))
+        return 0
+    rec = r.get("record")
+    if rec is None:
+        print(f"task {args.task_id!r}: not in the ledger "
+              "(never submitted here, or evicted beyond the spill)")
+    else:
+        print(f"task {rec['task_id'][:16]} {rec.get('name', '')!r} "
+              f"state={rec['state']}")
+        for tr in rec.get("transitions", ()):
+            t = time.strftime("%H:%M:%S", time.localtime(tr["t"]))
+            where = tr.get("node_id", "")[:12]
+            detail = tr.get("detail", "")
+            print(f"  {t} {tr['state']:<10} {where:<12} {detail}")
+        wf = r.get("waterfall") or {}
+        for ph in wf.get("phases", ()):
+            print(f"  {ph['phase']:<24} {ph['ms']:>10.3f}ms")
+        if wf.get("total_ms") is not None:
+            print(f"  total {wf['total_ms']:.3f}ms  "
+                  f"queue {wf.get('queue_ms', 0.0):.3f}ms  "
+                  f"exec {wf.get('exec_ms', 0.0):.3f}ms")
+    verdict = r.get("verdict") or (rec or {}).get("verdict")
+    if verdict:
+        print(f"verdict: {verdict.get('decision', '?')}"
+              + (f" — {verdict['constraint']}"
+                 if verdict.get("constraint") else ""))
+        for n in verdict.get("nodes_considered", ()):
+            print(f"  node {n['node_id']:<12} "
+                  f"{'OK ' if n.get('ok') else 'NO '} {n.get('reason', '')}")
+    for nid, info in sorted((r.get("nodes") or {}).items()):
+        if not info.get("queued"):
+            continue
+        print(f"queued on {nid}: position {info.get('queue_position')} "
+              f"of {info.get('queue_len')}, waited "
+              f"{info.get('waited_s', 0.0)}s")
+        if info.get("constraint"):
+            print(f"  why pending: {info['constraint']}")
+        for n in info.get("nodes_considered", ()):
+            print(f"  node {n['node_id']:<12} "
+                  f"{'OK ' if n.get('ok') else 'NO '} {n.get('reason', '')}")
+    for nid, err in sorted((r.get("errors") or {}).items()):
+        print(f"  MISSING node {nid}: {err}", file=sys.stderr)
+    return 0
+
+
+def cmd_critpath(args):
+    """Critical path: with --trace-id, the blocking chain of one
+    execution; without, the cross-execution aggregate (which work
+    blocks, how often, for how much total time)."""
+    from ray_tpu.util import state
+
+    r = state.critical_path(trace_id=args.trace_id, address=args.address)
+    if args.json:
+        print(json.dumps(r, indent=2, default=str))
+        return 0
+    if args.trace_id:
+        print(f"trace {r['trace_id'][:16]}: e2e {r['e2e_ms']:.3f}ms, "
+              f"path {r['path_ms']:.3f}ms "
+              f"({r['coverage'] * 100:.1f}% coverage), "
+              f"slowest: {r['slowest']}")
+        for c in r["chain"]:
+            print(f"  {c['name']:<32} {c['dur_ms']:>10.3f}ms "
+                  f"slack={c['slack_ms']:>8.3f}ms "
+                  f"node={c.get('node', '')[:12]}")
+    else:
+        print(f"{r['traces']} traces analyzed")
+        print(f"{'NAME':<32} {'COUNT':>6} {'TOTAL':>12} {'MEAN':>10} "
+              f"{'MAX':>10}  SHARE")
+        for e in r["entries"][:args.limit]:
+            print(f"{e['name']:<32} {e['count']:>6} "
+                  f"{e['total_ms']:>10.3f}ms {e['mean_ms']:>8.3f}ms "
+                  f"{e['max_ms']:>8.3f}ms  {e['share'] * 100:5.1f}%")
     return 0
 
 
@@ -460,6 +596,32 @@ def main(argv=None):
     p = sub.add_parser("status")
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("summary", help="one-screen cluster overview "
+                                       "(nodes, actors, ledger task "
+                                       "states, objects, alerts)")
+    p.add_argument("--address", required=True)
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("explain", help="why is this task pending / "
+                                       "why was it slow (ledger "
+                                       "waterfall + placement verdict)")
+    p.add_argument("task_id", help="task id hex (prefix ok)")
+    p.add_argument("--address", required=True)
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_explain)
+
+    p = sub.add_parser("critpath", help="critical-path analysis over "
+                                        "the merged span timeline")
+    p.add_argument("--address", required=True)
+    p.add_argument("--trace-id", dest="trace_id", default=None,
+                   help="one execution's blocking chain (default: "
+                        "aggregate across traces)")
+    p.add_argument("--limit", type=int, default=20,
+                   help="aggregate rows to show")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_critpath)
 
     p = sub.add_parser("list")
     p.add_argument("kind",

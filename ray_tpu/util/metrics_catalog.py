@@ -183,6 +183,18 @@ CATALOG: list[dict] = [
     {"name": "dag_executions_total", "type": "counter",
      "where": "ray_tpu/dag/__init__.py",
      "what": "compiled-DAG executions, by path (compiled|eager_fallback)"},
+    # task flight recorder (lifecycle ledger)
+    {"name": "task_queue_wait_seconds", "type": "histogram",
+     "where": "ray_tpu/core/nodelet.py",
+     "what": "time tasks spend in a nodelet's dispatch queue (enqueue "
+             "to dispatch) — the task-queue-stall rule's input"},
+    {"name": "task_ledger_events_total", "type": "counter",
+     "where": "ray_tpu/core/task_ledger.py",
+     "what": "lifecycle transitions ingested by the head task ledger"},
+    {"name": "task_ledger_dropped_total", "type": "counter",
+     "where": "ray_tpu/core/task_ledger.py",
+     "what": "lifecycle transitions dropped by the per-record "
+             "transition cap — drops counted, never silent"},
     # profiler plane
     {"name": "core_task_cpu_seconds_total", "type": "counter",
      "where": "ray_tpu/core/cluster_runtime.py",

@@ -54,6 +54,61 @@ def list_tasks(address: str | None = None, limit: int = 1000,
                       address=address, timeout=timeout)["tasks"]
 
 
+def task_ledger(task_id: str | None = None, limit: int = 0,
+                address: str | None = None, timeout: float = 30) -> dict:
+    """The head task lifecycle ledger (the fifth observability pillar):
+    per-state counts over the bounded ring plus its drop/spill stats —
+    ``{"counts": {state: n}, "stats": {...}}`` — and, when asked, one
+    joined record by `task_id` hex prefix (``"record"``, including the
+    evicted-to-disk spill) or the last-N record summaries
+    (``"records"``). Each record carries the full transition history:
+    SUBMITTED → QUEUED → LEASED/SCHEDULED/DISPATCHED → RUNNING →
+    FINISHED/FAILED/RETRIED with epoch timestamps and the scheduler's
+    last placement verdict."""
+    msg: dict = {}
+    if task_id:
+        msg["task_id"] = task_id
+    if limit:
+        msg["limit"] = limit
+    return _head_call("task_ledger", msg, address=address,
+                      timeout=timeout)
+
+
+def explain_task(task_id: str, address: str | None = None,
+                 timeout: float = 15) -> dict:
+    """`ray_tpu explain` — why is this task pending / why was it slow.
+
+    The head answers from the ledger (the transition waterfall and the
+    scheduler's recorded placement verdict) and, for a task that is
+    not yet terminal, fans out to every alive nodelet for live queue
+    state (is it queued there, queue position, wait so far, and a
+    per-node feasibility table naming which resource/label constraint
+    fails where). The fan-out runs under ONE shared deadline — a dead
+    node becomes an ``errors`` entry, never a failed query. Returns
+    ``{"record", "waterfall", "verdict", "nodes": {node12: {...}},
+    "errors": {node12: why}}``."""
+    return _head_call("explain_task",
+                      {"task_id": task_id, "timeout": timeout},
+                      address=address, timeout=timeout + 5)
+
+
+def critical_path(trace_id: str | None = None, address: str | None = None,
+                  timeout: float = 30) -> dict:
+    """Critical-path analysis over the head's span buffer (see
+    ``ray_tpu.util.critpath``): with a `trace_id`, the blocking chain
+    of that one execution (per-edge slack, e2e coverage, the slowest
+    entry); without, the aggregate across every trace in the buffer —
+    which work blocks executions and for how much total time ("where
+    does p99 live")."""
+    from ray_tpu.util import critpath as _cp
+
+    spans = _head_call("dump_timeline", address=address,
+                       timeout=timeout)["spans"]
+    if trace_id:
+        return _cp.critical_path(spans, trace_id)
+    return _cp.aggregate(spans)
+
+
 def cluster_metrics(address: str | None = None,
                     timeout: float = 30) -> str:
     """One Prometheus page for the whole cluster: the head scrapes every
@@ -475,6 +530,50 @@ def summarize(address: str | None = None) -> dict:
     }
 
 
+def cluster_summary(address: str | None = None,
+                    timeout: float = 20) -> dict:
+    """One-screen cluster overview (`ray_tpu summary`): nodes
+    alive/dead, actors by state, ledger task counts by lifecycle
+    state, object totals + stranded bytes, and firing alerts — each
+    section best-effort (a failed collector becomes an ``errors``
+    entry, the rest of the screen still renders)."""
+    out: dict = {"errors": {}}
+
+    def section(name, fn):
+        try:
+            out[name] = fn()
+        except Exception as e:  # noqa: BLE001
+            out["errors"][name] = repr(e)
+
+    section("cluster", lambda: summarize(address))
+
+    def _actors():
+        by_state: dict[str, int] = {}
+        for a in list_actors(address, timeout=timeout):
+            by_state[a["state"]] = by_state.get(a["state"], 0) + 1
+        return by_state
+
+    section("actors_by_state", _actors)
+    section("tasks", lambda: task_ledger(address=address, timeout=timeout))
+
+    def _objects():
+        m = memory_summary(address, timeout=timeout)
+        return {"objects_total": m["objects_total"],
+                "objects_bytes": m["objects_bytes"],
+                "stranded_count": m["stranded"]["count"],
+                "stranded_bytes": m["stranded"]["bytes"]}
+
+    section("objects", _objects)
+
+    def _alerts():
+        r = alerts(address, include_history=False, timeout=timeout)
+        return [a for a in r.get("alerts", ())
+                if a.get("state") in ("pending", "firing")]
+
+    section("alerts", _alerts)
+    return out
+
+
 def serve_status(address: str | None = None) -> dict:
     """Serve apps + per-replica health + per-proxy request metrics
     (reference: `ray serve status` / the serve state surface). The
@@ -624,6 +723,17 @@ def debug_dump(out_dir: str | None = None, address: str | None = None,
          jwrite("actors.json"))
     step("tasks", lambda: list_tasks(address, timeout=budget()),
          jwrite("tasks.json"))
+
+    # ledger records as JSONL: the joined per-task state machines with
+    # transition history — the first artifact a "why did task X stall"
+    # post-mortem greps (tasks.json above stays the flat event view)
+    def _task_ledger():
+        r = task_ledger(limit=2000, address=address, timeout=budget())
+        lines = [json.dumps(rec, default=str)
+                 for rec in r.get("records", ())]
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    step("task_ledger", _task_ledger, twrite("tasks.jsonl"))
     step("placement_groups",
          lambda: list_placement_groups(address, timeout=budget()),
          jwrite("placement_groups.json"))

@@ -471,6 +471,17 @@ def default_rules() -> list[WatchRule]:
                         "repeatedly; the firing alert carries the last "
                         "error lines as context"),
         WatchRule(
+            "task-queue-stall", metric="task_queue_wait_seconds",
+            stat="p99", op=">", threshold=float(os.environ.get(
+                "RAY_TPU_WATCHTOWER_QUEUE_WAIT_P99_S", "5.0")),
+            window_s=60, for_s=60, severity="warning",
+            description="task queue-wait p99 over "
+                        "RAY_TPU_WATCHTOWER_QUEUE_WAIT_P99_S (default "
+                        "5s) sustained 60s — the dispatch queue is "
+                        "stalling; `ray_tpu explain <task_id>` names "
+                        "the unsatisfiable constraint for the head of "
+                        "the queue"),
+        WatchRule(
             "object-stranded-refs",
             metric="object_store_stranded_bytes",
             stat="last", agg="sum", op=">",

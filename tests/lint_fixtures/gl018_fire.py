@@ -1,0 +1,21 @@
+"""GL018 firing fixture: unbounded accumulation on traffic paths."""
+
+
+class LeakyHead:
+    def __init__(self):
+        self._events = []
+        self._peers = set()
+        self._rows = []
+
+    def _h_task_event(self, msg):
+        self._events.append(msg)  # FIRE: handler append, no consumer
+
+    def _h_register(self, msg):
+        self._peers.add(msg["node_id"])  # FIRE: handler add, no discard
+
+    def poll_loop(self):
+        while True:
+            self._rows.extend(self._scrape())  # FIRE: loop extend
+
+    def _scrape(self):
+        return []

@@ -1,0 +1,37 @@
+"""GL018 clean fixture: every accumulation path carries a bound."""
+
+import collections
+
+
+class BoundedHead:
+    def __init__(self):
+        self._events = collections.deque(maxlen=10_000)  # bounded ctor
+        self._peers = set()
+        self._outbox = []
+        self._rows = []
+        self._staging = []
+
+    def _h_task_event(self, msg):
+        self._events.append(msg)  # deque(maxlen=...) never grows past cap
+
+    def _h_register(self, msg):
+        self._peers.add(msg["node_id"])
+
+    def _h_unregister(self, msg):
+        self._peers.discard(msg["node_id"])  # a consumer exists
+
+    def _h_enqueue(self, msg):
+        if len(self._outbox) < 5000:
+            self._outbox.append(msg)
+
+    def flush_loop(self):
+        batch, self._outbox = self._outbox, []  # drain-by-reassignment
+        return batch
+
+    def _h_retire(self, msg):
+        self._rows.append(msg)
+        del self._rows[:-100]  # trimmed in place
+
+    def record(self, item):
+        # not a handler or loop: builders/one-shot setup may append
+        self._staging.append(item)

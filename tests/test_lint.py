@@ -51,6 +51,7 @@ RULE_CASES = [
     ("GL014", "sequential-rpc-in-loop", "gl014_fire.py", "gl014_ok.py", 3),
     ("GL015", "wallclock-duration", "gl015_fire.py", "gl015_ok.py", 3),
     ("GL016", "bare-print", "gl016_fire.py", "gl016_ok.py", 3),
+    ("GL018", "unbounded-accumulator", "gl018_fire.py", "gl018_ok.py", 3),
 ]
 
 
@@ -73,7 +74,7 @@ def test_rule_catalog_complete():
     assert [c.code for c in catalog] == [
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
         "GL008", "GL009", "GL010", "GL011", "GL012", "GL013", "GL014",
-        "GL015", "GL016"]
+        "GL015", "GL016", "GL018"]
     for cls in catalog:
         assert cls.name and cls.description and cls.invariant
     index_catalog = index_rule_catalog()

@@ -230,7 +230,7 @@ def test_default_rule_pack_covers_catalog_signals():
             "replica-flapping", "span-plane-overload",
             "prefix-cache-thrash", "train-straggler",
             "train-stall", "train-pipeline-bubble", "log-error-spike",
-            "object-stranded-refs"} == set(rules)
+            "task-queue-stall", "object-stranded-refs"} == set(rules)
     for r in rules.values():
         assert r.severity in ("info", "warning", "critical")
         assert r.description
@@ -283,6 +283,42 @@ def test_pending_that_clears_never_fires():
     assert d["alerts"] == []
     assert [e["to"] for e in d["history"]] == ["pending", "resolved"]
     assert all(e["to"] != "firing" for e in d["history"])
+
+
+def test_task_queue_stall_rule_fires_and_resolves():
+    """The flight-recorder rule: queue-wait p99 over the threshold for
+    60s fires a warning; a burst of fast dispatches pulls the windowed
+    p99 back under and resolves it. Driven synthetically from the
+    cumulative bucket counts of `task_queue_wait_seconds`."""
+    rule = {r.name: r for r in default_rules()}["task-queue-stall"]
+    assert rule.severity == "warning" and rule.stat == "p99"
+    counts = {"fast": 0, "slow": 0}  # <=1s vs (1s, 10s] observations
+
+    def scrape():
+        le1 = counts["fast"]
+        le10 = counts["fast"] + counts["slow"]
+        return (
+            f'task_queue_wait_seconds_bucket{{le="1.0"}} {le1}\n'
+            f'task_queue_wait_seconds_bucket{{le="10.0"}} {le10}\n'
+            f'task_queue_wait_seconds_bucket{{le="+Inf"}} {le10}\n')
+
+    wt = Watchtower(scrape, period_s=0, rules=[rule])
+    states = []
+    # (dt-advance handled via explicit now=) each tick is 30s apart
+    for t, (fast, slow) in enumerate(
+            [(0, 0), (0, 10), (0, 20), (0, 30), (1000, 30), (2000, 30)]):
+        counts["fast"], counts["slow"] = fast, slow
+        wt.sample_once(now=float(t * 30))
+        active = wt.alerts_dict(include_history=False)["alerts"]
+        states.append(active[0]["state"] if active else "-")
+    # stalled dispatches land in the (1,10] bucket -> p99=10s > 5s:
+    # pending at 30s, firing once held for_s=60, resolved when the
+    # fast burst drags the windowed p99 under the threshold
+    assert states == ["-", "pending", "pending", "firing", "-", "-"]
+    d = wt.alerts_dict()
+    assert [(e["from"], e["to"]) for e in d["history"]] == [
+        (None, "pending"), ("pending", "firing"),
+        ("firing", "resolved")]
 
 
 def test_autodump_rate_limited_to_one_per_cooldown():
