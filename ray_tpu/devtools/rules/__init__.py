@@ -13,6 +13,7 @@ from ray_tpu.devtools.rules import (  # noqa: F401
     global_guard,
     guarded_by,
     handler_reentry,
+    host_sync_loop,
     host_transfer,
     lock_order,
     oneway_raise,

@@ -1,0 +1,168 @@
+"""GL019: device->host synchronization inside step/daemon loop bodies.
+
+The serve decode path exists to keep the accelerator busy: one program
+dispatch per step, results committed in (multi-token) batches. A
+``.item()`` / ``float()`` / ``np.asarray()`` / ``jax.device_get()`` on
+a device value *inside* a ``*_loop`` method body is the anti-pattern
+that un-does it — every iteration blocks the host on the device
+pipeline to materialize one scalar, serializing dispatch against
+compute (the per-token host round-trip speculative decoding was built
+to avoid; see SERVING.md "Speculative decoding").
+
+What counts as a device value (flow-insensitive taint, per function):
+
+- the result of a jit-program dispatch — a call whose callee ends with
+  ``_jit`` (the house idiom ``self._decode_jit = jax.jit(...)``);
+- the result of a ``jnp.*`` / ``jax.lax.*`` / ``jax.nn.*`` call;
+- anything derived from one: tuple-unpacked, subscripted, method
+  results on a tainted receiver, arithmetic on tainted operands.
+
+Sinks that fire on a tainted value: ``.item()`` / ``.tolist()``,
+``float()`` / ``int()`` / ``bool()`` casts, ``np.asarray()`` /
+``np.array()``. ``jax.device_get()`` fires unconditionally — it is a
+host sync by definition, whatever the linter can prove about its
+argument. Host-value uses (``float(cfg.get(...))``, ``np.asarray``
+of a python list) stay quiet, as do syncs in non-loop methods: the
+discipline is *batch the transfer at the loop/commit boundary*, not
+*never transfer*. GL004 covers the same calls inside traced code;
+this rule covers the host-side dispatch loop around it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools.context import ModuleContext, qualname
+from ray_tpu.devtools.registry import Rule, register
+
+_SYNC_METHODS = frozenset(("item", "tolist"))
+_NP_SINKS = frozenset(("numpy.asarray", "numpy.array"))
+_CASTS = frozenset(("float", "int", "bool"))
+_DEVICE_PREFIXES = ("jax.numpy.", "jax.lax.", "jax.nn.")
+
+
+def _is_device_call(node: ast.AST, ctx: ModuleContext) -> bool:
+    """A call that returns device arrays: a ``*_jit`` program dispatch
+    or a jnp/jax.lax/jax.nn op."""
+    if not isinstance(node, ast.Call):
+        return False
+    qn = qualname(node.func)
+    if qn is None:
+        return False
+    if qn.rsplit(".", 1)[-1].endswith("_jit"):
+        return True
+    return ctx.resolve(qn).startswith(_DEVICE_PREFIXES)
+
+
+@register
+class HostSyncLoopRule(Rule):
+    name = "host-sync-in-step-loop"
+    code = "GL019"
+    description = (".item()/float()/np.asarray/jax.device_get on a "
+                   "device value inside a *_loop body — a per-"
+                   "iteration device->host pipeline sync")
+    invariant = ("step/daemon loops keep values on device and batch "
+                 "the host transfer at the loop or commit boundary, "
+                 "never once per iteration")
+    interests = ("FunctionDef", "AsyncFunctionDef")
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if not node.name.endswith("_loop"):
+            return
+        tainted = self._tainted_names(node, ctx)
+
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            msg = self._sink(sub, tainted, ctx)
+            if msg is not None:
+                ctx.report(self, sub,
+                           f"{msg} in loop {node.name}() blocks the "
+                           "host on the device pipeline every "
+                           "iteration — keep it on device and batch "
+                           "the transfer at the loop/commit boundary")
+
+    # ---------------------------------------------------------- taint
+
+    def _tainted_names(self, fn: ast.AST,
+                       ctx: ModuleContext) -> set[str]:
+        """Names ever bound to a device value in this function —
+        flow-insensitive, iterated to a fixpoint so derivation chains
+        (``x = jit(...); y = x[0]``) and loop-carried values land."""
+        assigns: list[tuple[list[str], ast.AST]] = []
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign):
+                targets, value = sub.targets, sub.value
+            elif isinstance(sub, (ast.AnnAssign, ast.AugAssign)):
+                targets, value = [sub.target], sub.value
+            else:
+                continue
+            if value is None:
+                continue
+            names: list[str] = []
+            for tgt in targets:
+                elts = (tgt.elts if isinstance(tgt, (ast.Tuple, ast.List))
+                        else [tgt])
+                names.extend(e.id for e in elts
+                             if isinstance(e, ast.Name))
+            if names:
+                assigns.append((names, value))
+
+        tainted: set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for names, value in assigns:
+                if self._expr_tainted(value, tainted, ctx):
+                    for name in names:
+                        if name not in tainted:
+                            tainted.add(name)
+                            changed = True
+        return tainted
+
+    def _expr_tainted(self, node: ast.AST, tainted: set[str],
+                      ctx: ModuleContext) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        if isinstance(node, (ast.Subscript, ast.Starred)):
+            return self._expr_tainted(node.value, tainted, ctx)
+        if isinstance(node, ast.Call):
+            if _is_device_call(node, ctx):
+                return True
+            # method result on a tainted receiver: logits.max()
+            return (isinstance(node.func, ast.Attribute)
+                    and self._expr_tainted(node.func.value, tainted,
+                                           ctx))
+        if isinstance(node, ast.BinOp):
+            return (self._expr_tainted(node.left, tainted, ctx)
+                    or self._expr_tainted(node.right, tainted, ctx))
+        if isinstance(node, ast.UnaryOp):
+            return self._expr_tainted(node.operand, tainted, ctx)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._expr_tainted(e, tainted, ctx)
+                       for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return (self._expr_tainted(node.body, tainted, ctx)
+                    or self._expr_tainted(node.orelse, tainted, ctx))
+        return False
+
+    # ---------------------------------------------------------- sinks
+
+    def _sink(self, node: ast.Call, tainted: set[str],
+              ctx: ModuleContext) -> str | None:
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS
+                and not node.args and not node.keywords
+                and self._expr_tainted(f.value, tainted, ctx)):
+            return f".{f.attr}() on a device value"
+        if (isinstance(f, ast.Name) and f.id in _CASTS
+                and len(node.args) == 1 and not node.keywords
+                and self._expr_tainted(node.args[0], tainted, ctx)):
+            return f"{f.id}() cast of a device value"
+        resolved = ctx.resolve_call(node)
+        if resolved == "jax.device_get":
+            return "jax.device_get()"
+        if (resolved in _NP_SINKS and node.args
+                and self._expr_tainted(node.args[0], tainted, ctx)):
+            return f"{resolved}() on a device value"
+        return None

@@ -290,14 +290,21 @@ def gpt2_prefill_kv(
     return logits.astype(jnp.float32), k, v
 
 
-def _chunk_block(x, p, k_ctx, v_ctx, ctx_mask, chunk_mask, cfg: GPT2Config):
+def _chunk_block(x, p, k_ctx, v_ctx, ctx_mask, chunk_mask, cfg: GPT2Config,
+                 attend=None):
     """Chunked-prefill block step. x (B, T, E) holds a CHUNK of the
     sequence at absolute positions start..start+T-1; k_ctx/v_ctx
     (B, C, H, D) hold the already-cached context for positions < start
     (ctx_mask (B, C) marks valid slots); chunk_mask (B, T) marks real
     (non-padded) chunk positions. Attention is context + causal within
     the chunk. Returns (x, (k, v)) with k/v (B, T, H, D) — the chunk's
-    cache contribution."""
+    cache contribution.
+
+    With ``attend`` set (paged-attention path) the dense context math
+    is replaced by ``attend(q, k, v) -> (B, T, H, D)``: k_ctx/v_ctx are
+    then this layer's page-pool arrays captured by the closure and the
+    masking lives inside the kernel; projections/MLP stay shared with
+    the dense path."""
     B, T, E = x.shape
     dt = cfg.dtype
     H, D = cfg.n_head, cfg.head_dim
@@ -306,20 +313,24 @@ def _chunk_block(x, p, k_ctx, v_ctx, ctx_mask, chunk_mask, cfg: GPT2Config):
     qkv = constrain(qkv, ("data", "fsdp"), None, "tensor")
     q, k, v = (t.reshape(B, T, H, D) for t in jnp.split(qkv, 3, axis=-1))
 
-    scale = 1.0 / (D**0.5)
-    s_ctx = jnp.einsum("bthd,bchd->bhtc", q, k_ctx).astype(jnp.float32)
-    s_own = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
-    s = jnp.concatenate([s_ctx, s_own], axis=-1) * scale
-    causal = jnp.tril(jnp.ones((T, T), dtype=bool))
-    valid = jnp.concatenate(
-        [jnp.broadcast_to(ctx_mask[:, None, :], (B, T, ctx_mask.shape[1])),
-         causal[None] & chunk_mask[:, None, :]], axis=-1)
-    s = jnp.where(valid[:, None, :, :], s, -1e30)
-    probs = jax.nn.softmax(s, axis=-1).astype(dt)
-    C = k_ctx.shape[1]
-    att = jnp.einsum("bhtc,bchd->bthd", probs[..., :C], v_ctx) \
-        + jnp.einsum("bhts,bshd->bthd", probs[..., C:], v)
-    att = att.reshape(B, T, E)
+    if attend is not None:
+        att = attend(q, k, v).reshape(B, T, E)
+    else:
+        scale = 1.0 / (D**0.5)
+        s_ctx = jnp.einsum("bthd,bchd->bhtc", q, k_ctx).astype(jnp.float32)
+        s_own = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
+        s = jnp.concatenate([s_ctx, s_own], axis=-1) * scale
+        causal = jnp.tril(jnp.ones((T, T), dtype=bool))
+        valid = jnp.concatenate(
+            [jnp.broadcast_to(ctx_mask[:, None, :],
+                              (B, T, ctx_mask.shape[1])),
+             causal[None] & chunk_mask[:, None, :]], axis=-1)
+        s = jnp.where(valid[:, None, :, :], s, -1e30)
+        probs = jax.nn.softmax(s, axis=-1).astype(dt)
+        C = k_ctx.shape[1]
+        att = jnp.einsum("bhtc,bchd->bthd", probs[..., :C], v_ctx) \
+            + jnp.einsum("bhts,bshd->bthd", probs[..., C:], v)
+        att = att.reshape(B, T, E)
     att = att @ p["attn_proj"]["kernel"].astype(dt) + p["attn_proj"]["bias"].astype(dt)
     x = x + constrain(att, ("data", "fsdp"), None, None)
 
@@ -376,10 +387,13 @@ def gpt2_prefill_chunk_kv(
     return logits.astype(jnp.float32), k, v
 
 
-def _decode_block(x, p, k_ctx, v_ctx, ctx_mask, cfg: GPT2Config):
+def _decode_block(x, p, k_ctx, v_ctx, ctx_mask, cfg: GPT2Config,
+                  attend=None):
     """Single-token block step. x (B, E); k_ctx/v_ctx (B, C, H, D) hold
     the sequence's cached context (padded; ctx_mask (B, C) marks valid
-    slots). Returns (x, (k_new, v_new)) with k_new/v_new (B, H, D)."""
+    slots). Returns (x, (k_new, v_new)) with k_new/v_new (B, H, D).
+    ``attend(q, k, v) -> (B, H, D)`` swaps in the paged-attention
+    kernel (see `_chunk_block`)."""
     B, E = x.shape
     dt = cfg.dtype
     H, D = cfg.n_head, cfg.head_dim
@@ -388,18 +402,21 @@ def _decode_block(x, p, k_ctx, v_ctx, ctx_mask, cfg: GPT2Config):
     qkv = constrain(qkv, ("data", "fsdp"), "tensor")
     q, k, v = (t.reshape(B, H, D) for t in jnp.split(qkv, 3, axis=-1))
 
-    scale = 1.0 / (D**0.5)
-    # context scores + the token's own (diagonal) score, softmax in f32
-    s_ctx = jnp.einsum("bhd,bchd->bhc", q, k_ctx).astype(jnp.float32)
-    s_own = jnp.sum(q * k, axis=-1, dtype=jnp.float32)
-    s = jnp.concatenate([s_ctx, s_own[:, :, None]], axis=-1) * scale
-    valid = jnp.concatenate(
-        [ctx_mask, jnp.ones((B, 1), dtype=bool)], axis=-1)
-    s = jnp.where(valid[:, None, :], s, -1e30)
-    probs = jax.nn.softmax(s, axis=-1).astype(dt)
-    att = jnp.einsum("bhc,bchd->bhd", probs[..., :-1], v_ctx) \
-        + probs[..., -1:] * v
-    att = att.reshape(B, E)
+    if attend is not None:
+        att = attend(q, k, v).reshape(B, E)
+    else:
+        scale = 1.0 / (D**0.5)
+        # context scores + the token's own (diagonal) score, f32 softmax
+        s_ctx = jnp.einsum("bhd,bchd->bhc", q, k_ctx).astype(jnp.float32)
+        s_own = jnp.sum(q * k, axis=-1, dtype=jnp.float32)
+        s = jnp.concatenate([s_ctx, s_own[:, :, None]], axis=-1) * scale
+        valid = jnp.concatenate(
+            [ctx_mask, jnp.ones((B, 1), dtype=bool)], axis=-1)
+        s = jnp.where(valid[:, None, :], s, -1e30)
+        probs = jax.nn.softmax(s, axis=-1).astype(dt)
+        att = jnp.einsum("bhc,bchd->bhd", probs[..., :-1], v_ctx) \
+            + probs[..., -1:] * v
+        att = att.reshape(B, E)
     att = att @ p["attn_proj"]["kernel"].astype(dt) + p["attn_proj"]["bias"].astype(dt)
     x = x + constrain(att, ("data", "fsdp"), None)
 
@@ -440,6 +457,96 @@ def gpt2_decode_kv(
     x = _layer_norm(x, params["lnf"]["scale"], params["lnf"]["bias"])
     logits = x @ params["wte"].astype(dt).T
     return logits.astype(jnp.float32), k_new, v_new
+
+
+# --------------------------------------------------------------------------
+# Paged-attention inference steps: same block math (projections, MLP,
+# residuals shared via the `attend` hook), but the attention core is the
+# ops/paged_attention.py kernel indexing the page pool in place — no
+# dense (L, B, C, H, D) context gather. k_pages/v_pages are the pool
+# arrays (L, num_blocks, block_size, H, D); the scan walks layers and
+# per-layer page arrays together.
+
+
+def gpt2_decode_paged_kv(
+    params: Params,
+    tokens: jax.Array,
+    positions: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    tables: jax.Array,
+    cfg: GPT2Config,
+    *,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step against the page pool. tokens/positions (B,);
+    tables (B, max_blocks_per_seq). Returns (logits (B, Vp) f32,
+    k_new, v_new (L, B, H, D)) — caller scatters, like gpt2_decode_kv."""
+    from ray_tpu.ops.paged_attention import paged_attention
+
+    dt = cfg.dtype
+    x = params["wte"].astype(dt)[tokens] \
+        + params["wpe"].astype(dt)[positions]
+
+    def body(carry, xs):
+        p, kp, vp = xs
+
+        def attend(q, k, v):
+            o = paged_attention(q[:, None], k[:, None], v[:, None],
+                                kp, vp, tables, positions,
+                                interpret=interpret)
+            return o[:, 0]
+
+        return _decode_block(carry, p, None, None, None, cfg,
+                             attend=attend)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["blocks"], k_pages, v_pages))
+    x = _layer_norm(x, params["lnf"]["scale"], params["lnf"]["bias"])
+    logits = x @ params["wte"].astype(dt).T
+    return logits.astype(jnp.float32), k_new, v_new
+
+
+def gpt2_verify_paged_kv(
+    params: Params,
+    tokens: jax.Array,
+    start: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    table: jax.Array,
+    cfg: GPT2Config,
+    *,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Speculative verify window against the page pool: tokens (1, W)
+    at absolute positions start..start+W-1, table (max_blocks_per_seq,)
+    covering cached positions < start. Causal within the window (no
+    chunk mask — a window row only ever attends rows before it, and
+    rows past the draft count are discarded by the caller). Returns
+    (logits (1, W, Vp) f32, k, v (L, 1, W, H, D))."""
+    from ray_tpu.ops.paged_attention import paged_attention
+
+    B, T = tokens.shape
+    dt = cfg.dtype
+    pos = jnp.clip(start + jnp.arange(T), 0, cfg.block_size - 1)
+    x = params["wte"].astype(dt)[tokens] + params["wpe"].astype(dt)[pos]
+    tables = table[None]  # (1, maxB)
+    ctx_len = jnp.reshape(jnp.asarray(start, jnp.int32), (1,))
+
+    def body(carry, xs):
+        p, kp, vp = xs
+
+        def attend(q, k, v):
+            return paged_attention(q, k, v, kp, vp, tables, ctx_len,
+                                   interpret=interpret)
+
+        return _chunk_block(carry, p, None, None, None, None, cfg,
+                            attend=attend)
+
+    x, (k, v) = jax.lax.scan(body, x, (params["blocks"], k_pages, v_pages))
+    x = _layer_norm(x, params["lnf"]["scale"], params["lnf"]["bias"])
+    logits = x @ params["wte"].astype(dt).T
+    return logits.astype(jnp.float32), k, v
 
 
 def count_params(params: Params) -> int:

@@ -251,12 +251,14 @@ def _rope_chunk(x, start, theta: float):
 
 
 def _chunk_block(x, p, k_ctx, v_ctx, ctx_mask, chunk_mask, start,
-                 cfg: LlamaConfig):
+                 cfg: LlamaConfig, attend=None):
     """Chunked-prefill block step; see models/gpt2.py `_chunk_block`.
     x (B, T, E) at absolute positions start..start+T-1; k_ctx/v_ctx
     (B, C, Hkv, D) post-rope cached context. Returns (x, (k, v)) with
     k/v (B, T, Hkv, D) post-rope, pre-GQA-replication — the cached
-    layout."""
+    layout. ``attend(q, k, v) -> (B, T, H, D)`` (k/v pre-replication)
+    swaps in the paged-attention kernel, which does the GQA head
+    mapping itself."""
     B, T, E = x.shape
     dt = cfg.dtype
     hd = cfg.head_dim
@@ -270,26 +272,30 @@ def _chunk_block(x, p, k_ctx, v_ctx, ctx_mask, chunk_mask, start,
     k = _rope_chunk(k, start, cfg.rope_theta)
     k_cache, v_cache = k, v
 
-    rep = H // HK
-    kce = jnp.repeat(k_ctx, rep, axis=2)
-    vce = jnp.repeat(v_ctx, rep, axis=2)
-    ke = jnp.repeat(k, rep, axis=2)
-    ve = jnp.repeat(v, rep, axis=2)
+    if attend is not None:
+        att = attend(q, k, v).reshape(B, T, E) @ p["wo"].astype(dt)
+    else:
+        rep = H // HK
+        kce = jnp.repeat(k_ctx, rep, axis=2)
+        vce = jnp.repeat(v_ctx, rep, axis=2)
+        ke = jnp.repeat(k, rep, axis=2)
+        ve = jnp.repeat(v, rep, axis=2)
 
-    scale = 1.0 / (hd**0.5)
-    s_ctx = jnp.einsum("bthd,bchd->bhtc", q, kce).astype(jnp.float32)
-    s_own = jnp.einsum("bthd,bshd->bhts", q, ke).astype(jnp.float32)
-    s = jnp.concatenate([s_ctx, s_own], axis=-1) * scale
-    causal = jnp.tril(jnp.ones((T, T), dtype=bool))
-    valid = jnp.concatenate(
-        [jnp.broadcast_to(ctx_mask[:, None, :], (B, T, ctx_mask.shape[1])),
-         causal[None] & chunk_mask[:, None, :]], axis=-1)
-    s = jnp.where(valid[:, None, :, :], s, -1e30)
-    probs = jax.nn.softmax(s, axis=-1).astype(dt)
-    C = k_ctx.shape[1]
-    att = jnp.einsum("bhtc,bchd->bthd", probs[..., :C], vce) \
-        + jnp.einsum("bhts,bshd->bthd", probs[..., C:], ve)
-    att = att.reshape(B, T, E) @ p["wo"].astype(dt)
+        scale = 1.0 / (hd**0.5)
+        s_ctx = jnp.einsum("bthd,bchd->bhtc", q, kce).astype(jnp.float32)
+        s_own = jnp.einsum("bthd,bshd->bhts", q, ke).astype(jnp.float32)
+        s = jnp.concatenate([s_ctx, s_own], axis=-1) * scale
+        causal = jnp.tril(jnp.ones((T, T), dtype=bool))
+        valid = jnp.concatenate(
+            [jnp.broadcast_to(ctx_mask[:, None, :],
+                              (B, T, ctx_mask.shape[1])),
+             causal[None] & chunk_mask[:, None, :]], axis=-1)
+        s = jnp.where(valid[:, None, :, :], s, -1e30)
+        probs = jax.nn.softmax(s, axis=-1).astype(dt)
+        C = k_ctx.shape[1]
+        att = jnp.einsum("bhtc,bchd->bthd", probs[..., :C], vce) \
+            + jnp.einsum("bhts,bshd->bthd", probs[..., C:], ve)
+        att = att.reshape(B, T, E) @ p["wo"].astype(dt)
     x = x + constrain(att, ("data", "fsdp"), None, None)
 
     h = _rmsnorm(x, p["ln_mlp"], cfg.rms_eps)
@@ -332,10 +338,13 @@ def llama_prefill_chunk_kv(
     return logits.astype(jnp.float32), k, v
 
 
-def _decode_block(x, p, k_ctx, v_ctx, ctx_mask, positions, cfg: LlamaConfig):
+def _decode_block(x, p, k_ctx, v_ctx, ctx_mask, positions, cfg: LlamaConfig,
+                  attend=None):
     """Single-token block step; x (B, E), k_ctx/v_ctx (B, C, Hkv, D)
     post-rope cached context, ctx_mask (B, C), positions (B,).
-    Returns (x, (k_new, v_new)) with k_new/v_new (B, Hkv, D)."""
+    Returns (x, (k_new, v_new)) with k_new/v_new (B, Hkv, D).
+    ``attend(q, k, v) -> (B, H, D)`` (k/v pre-replication) swaps in the
+    paged-attention kernel (see `_chunk_block`)."""
     B, E = x.shape
     dt = cfg.dtype
     hd = cfg.head_dim
@@ -348,23 +357,26 @@ def _decode_block(x, p, k_ctx, v_ctx, ctx_mask, positions, cfg: LlamaConfig):
     q = _rope_at(q, positions, cfg.rope_theta)
     k = _rope_at(k, positions, cfg.rope_theta)
 
-    rep = H // HK
-    kce = jnp.repeat(k_ctx, rep, axis=2)
-    vce = jnp.repeat(v_ctx, rep, axis=2)
-    ke = jnp.repeat(k, rep, axis=1)
-    ve = jnp.repeat(v, rep, axis=1)
+    if attend is not None:
+        att = attend(q, k, v).reshape(B, E) @ p["wo"].astype(dt)
+    else:
+        rep = H // HK
+        kce = jnp.repeat(k_ctx, rep, axis=2)
+        vce = jnp.repeat(v_ctx, rep, axis=2)
+        ke = jnp.repeat(k, rep, axis=1)
+        ve = jnp.repeat(v, rep, axis=1)
 
-    scale = 1.0 / (hd**0.5)
-    s_ctx = jnp.einsum("bhd,bchd->bhc", q, kce).astype(jnp.float32)
-    s_own = jnp.sum(q * ke, axis=-1, dtype=jnp.float32)
-    s = jnp.concatenate([s_ctx, s_own[:, :, None]], axis=-1) * scale
-    valid = jnp.concatenate(
-        [ctx_mask, jnp.ones((B, 1), dtype=bool)], axis=-1)
-    s = jnp.where(valid[:, None, :], s, -1e30)
-    probs = jax.nn.softmax(s, axis=-1).astype(dt)
-    att = jnp.einsum("bhc,bchd->bhd", probs[..., :-1], vce) \
-        + probs[..., -1:] * ve
-    att = att.reshape(B, E) @ p["wo"].astype(dt)
+        scale = 1.0 / (hd**0.5)
+        s_ctx = jnp.einsum("bhd,bchd->bhc", q, kce).astype(jnp.float32)
+        s_own = jnp.sum(q * ke, axis=-1, dtype=jnp.float32)
+        s = jnp.concatenate([s_ctx, s_own[:, :, None]], axis=-1) * scale
+        valid = jnp.concatenate(
+            [ctx_mask, jnp.ones((B, 1), dtype=bool)], axis=-1)
+        s = jnp.where(valid[:, None, :], s, -1e30)
+        probs = jax.nn.softmax(s, axis=-1).astype(dt)
+        att = jnp.einsum("bhc,bchd->bhd", probs[..., :-1], vce) \
+            + probs[..., -1:] * ve
+        att = att.reshape(B, E) @ p["wo"].astype(dt)
     x = x + constrain(att, ("data", "fsdp"), None)
 
     h = _rmsnorm(x, p["ln_mlp"], cfg.rms_eps)
@@ -401,6 +413,87 @@ def llama_decode_kv(
     x = _rmsnorm(x, params["lnf"], cfg.rms_eps)
     logits = x @ params["wte"].astype(dt).T
     return logits.astype(jnp.float32), k_new, v_new
+
+
+# --------------------------------------------------------------------------
+# Paged-attention inference steps — see models/gpt2.py: same block math
+# through the `attend` hook, attention core is the ops/paged_attention
+# kernel over the page pool (L, num_blocks, block_size, Hkv, D). The
+# kernel does the GQA head mapping, so K/V stay pre-replication.
+
+
+def llama_decode_paged_kv(
+    params: Params,
+    tokens: jax.Array,
+    positions: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    tables: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step against the page pool; see llama_decode_kv.
+    Returns (logits (B, Vp) f32, k_new, v_new (L, B, Hkv, D))."""
+    from ray_tpu.ops.paged_attention import paged_attention
+
+    dt = cfg.dtype
+    x = params["wte"].astype(dt)[tokens]
+
+    def body(carry, xs):
+        p, kp, vp = xs
+
+        def attend(q, k, v):
+            o = paged_attention(q[:, None], k[:, None], v[:, None],
+                                kp, vp, tables, positions,
+                                interpret=interpret)
+            return o[:, 0]
+
+        return _decode_block(carry, p, None, None, None, positions,
+                             cfg, attend=attend)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["blocks"], k_pages, v_pages))
+    x = _rmsnorm(x, params["lnf"], cfg.rms_eps)
+    logits = x @ params["wte"].astype(dt).T
+    return logits.astype(jnp.float32), k_new, v_new
+
+
+def llama_verify_paged_kv(
+    params: Params,
+    tokens: jax.Array,
+    start: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    table: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Speculative verify window against the page pool; see
+    gpt2_verify_paged_kv. tokens (1, W) at positions start..start+W-1.
+    Returns (logits (1, W, Vp) f32, k, v (L, 1, W, Hkv, D))."""
+    from ray_tpu.ops.paged_attention import paged_attention
+
+    dt = cfg.dtype
+    x = params["wte"].astype(dt)[tokens]
+    tables = table[None]  # (1, maxB)
+    ctx_len = jnp.reshape(jnp.asarray(start, jnp.int32), (1,))
+
+    def body(carry, xs):
+        p, kp, vp = xs
+
+        def attend(q, k, v):
+            return paged_attention(q, k, v, kp, vp, tables, ctx_len,
+                                   interpret=interpret)
+
+        return _chunk_block(carry, p, None, None, None, None, start,
+                            cfg, attend=attend)
+
+    x, (k, v) = jax.lax.scan(body, x, (params["blocks"], k_pages, v_pages))
+    x = _rmsnorm(x, params["lnf"], cfg.rms_eps)
+    logits = x @ params["wte"].astype(dt).T
+    return logits.astype(jnp.float32), k, v
 
 
 def llama_loss(params: Params, batch: dict, cfg: LlamaConfig) -> jax.Array:

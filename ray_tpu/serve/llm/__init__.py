@@ -45,18 +45,26 @@ from ray_tpu.serve.llm.deployment import (
 from ray_tpu.serve.llm.engine import LLMEngine, RequestStream
 from ray_tpu.serve.llm.runner import ModelRunner
 from ray_tpu.serve.llm.scheduler import Scheduler, Sequence, SeqState
+from ray_tpu.serve.llm.spec import (
+    DraftProposer,
+    NGramProposer,
+    SpeculativeConfig,
+)
 
 __all__ = [
     "BlockPool",
+    "DraftProposer",
     "EngineConfig",
     "LLMEngine",
     "LLMServer",
     "ModelRunner",
+    "NGramProposer",
     "RequestStream",
     "SamplingParams",
     "Scheduler",
     "SeqState",
     "Sequence",
+    "SpeculativeConfig",
     "build_llm_app",
     "prompt_affinity_key",
 ]

@@ -87,6 +87,12 @@ class EngineConfig:
     # physical pages and skip their prefill entirely
     enable_prefix_cache: bool = True
     seed: int = 0  # weight init seed when no params are passed
+    # speculative decoding: SpeculativeConfig | dict | None (off).
+    # See serve/llm/spec.py — greedy outputs stay bit-identical.
+    speculative: Any = None
+    # paged-attention pallas kernel for decode + verify (interpret mode
+    # on CPU, real kernel on TPU). Off => dense gathered-context math.
+    use_paged_attention: bool = False
 
     def __post_init__(self):
         if self.block_size < 1:
@@ -95,6 +101,8 @@ class EngineConfig:
             raise ValueError("max_batch_size must be >= 1")
         if self.prefill_chunk_size < 0:
             raise ValueError("prefill_chunk_size must be >= 0")
+        from ray_tpu.serve.llm.spec import SpeculativeConfig
+        self.speculative = SpeculativeConfig.from_payload(self.speculative)
 
     @staticmethod
     def from_dict(d: dict) -> "EngineConfig":

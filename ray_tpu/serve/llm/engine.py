@@ -149,6 +149,13 @@ class LLMEngine:
         self.pool = BlockPool(
             num_blocks, config.block_size,
             enable_prefix_cache=(config.enable_prefix_cache and chunking))
+        # speculative decoding: proposer on the host, verify program on
+        # the device; greedy outputs stay bit-identical to spec-off
+        from ray_tpu.serve.llm.spec import build_proposer
+
+        spec_cfg = config.speculative
+        self._proposer = build_proposer(spec_cfg) if spec_cfg else None
+        self._spec_k = spec_cfg.num_draft_tokens if spec_cfg else 0
         self.runner = ModelRunner(
             adapter, cfg, params,
             block_size=config.block_size,
@@ -160,13 +167,16 @@ class LLMEngine:
                                 else None),
             mesh=mesh,
             sample_seed=config.seed + 1,
+            num_draft_tokens=self._spec_k,
+            use_paged_attention=config.use_paged_attention,
         )
         self.scheduler = Scheduler(
             self.pool, max_batch_size=config.max_batch_size,
             max_model_len=max_len,
             # the runner rounds the chunk to a page-aligned size; reuse
             # its value so scheduler chunks match the compiled buckets
-            chunk_size=(self.runner.prefill_chunk_size or 0))
+            chunk_size=(self.runner.prefill_chunk_size or 0),
+            spec_tokens=self._spec_k)
 
         self._ids = itertools.count()
         self._streams: dict[int, RequestStream] = {}  # guarded_by(_lock)
@@ -264,10 +274,42 @@ class LLMEngine:
             tag_keys=("model", "phase"))
         self._m_slo_tpot = Histogram(
             "serve_slo_tpot_ms",
-            "Time per output token after the first (decode phase "
-            "seconds / tokens)",
+            "Time per output token after the first (decode + verify "
+            "phase seconds / tokens committed after the first — "
+            "speculative steps commit several tokens per dispatch, so "
+            "per-step time is divided over tokens actually committed)",
             boundaries=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500),
             tag_keys=tags)
+        # speculative decoding plane: proposed = draft tokens sent to
+        # verify; accepted + rejected = proposed (watchtower's
+        # spec-accept-collapse rule reads the accepted:rejected ratio)
+        self._m_spec_proposed = Counter(
+            "serve_llm_spec_proposed_total",
+            "Draft tokens proposed to the verify program", tag_keys=tags)
+        self._m_spec_accepted = Counter(
+            "serve_llm_spec_accepted_total",
+            "Draft tokens accepted by the verify program", tag_keys=tags)
+        self._m_spec_rejected = Counter(
+            "serve_llm_spec_rejected_total",
+            "Draft tokens rejected by the verify program", tag_keys=tags)
+        self._m_spec_ratio = Gauge(
+            "serve_llm_spec_accept_ratio",
+            "Cumulative draft acceptance ratio (accepted / proposed)",
+            tag_keys=tags)
+        self._m_verify_ms = Histogram(
+            "serve_llm_verify_step_ms",
+            "Speculative verify dispatch latency (one drafted run)",
+            boundaries=(1, 5, 10, 25, 50, 100, 250, 500, 1000),
+            tag_keys=tags)
+        self._m_paged = Gauge(
+            "serve_llm_paged_attn_enabled",
+            "1 when decode/verify run the pallas paged-attention "
+            "kernel, 0 on the dense fallback", tag_keys=tags)
+        self._m_paged.set(
+            1.0 if self.runner.use_paged_attention else 0.0,
+            tags=self._m_tags)
+        self._spec_proposed_total = 0
+        self._spec_accepted_total = 0
         # counter deltas are computed against the last pump
         self._last_prefix = (0, 0, 0)
 
@@ -450,40 +492,125 @@ class LLMEngine:
             self._finalize(seq)
 
     def _do_decode(self, work: DecodeWork) -> None:
+        ver = self._weight_version  # stable: step holds _step_lock
+        plain: list[Sequence] = []
+        drafted: list[tuple[Sequence, list[int]]] = []
+        if self._proposer is not None:
+            for s in work.seqs:
+                d = self._propose_for(s)
+                if d:
+                    drafted.append((s, d))
+                else:
+                    plain.append(s)
+        else:
+            plain = list(work.seqs)
+        if plain:
+            self._decode_plain(plain, ver)
+        for s, d in drafted:
+            self._verify_one(s, d, ver)
+
+    def _propose_for(self, seq: Sequence) -> list[int]:
+        """Draft tokens for one lane, clamped so every drafted write
+        position fits the pages the lane owns, stays below
+        max_model_len, and cannot overshoot the request's max_tokens —
+        under cache pressure the clamp hits zero and the lane decodes
+        exactly as without spec."""
+        room = min(
+            len(seq.table) * self.pool.block_size - seq.pos,
+            self.runner.max_model_len - seq.pos,
+            seq.sampling.max_tokens - len(seq.generated) - 1)
+        k = min(self._spec_k, room)
+        if k <= 0:
+            return []
+        return self._proposer.propose(
+            list(seq.prompt) + list(seq.generated), k)[:k]
+
+    def _decode_plain(self, seqs: list[Sequence], ver: int) -> None:
         # the lane feeds generated[-1], which LIVES at absolute position
         # pos-1 (it was sampled but never cached): rope/wpe index, the
         # context mask, and the KV scatter all key off that position
-        ver = self._weight_version  # stable: step holds _step_lock
         items = [DecodeItem(s.last_token, s.pos - 1, s.table,
                             s.sampling.temperature, s.sampling.top_k,
-                            s.sampling.top_p) for s in work.seqs]
+                            s.sampling.top_p) for s in seqs]
         try:
             next_tokens, logits = self.runner.decode(items)
         except Exception as e:  # noqa: BLE001
             with self._lock:
-                for s in work.seqs:
+                for s in seqs:
                     self.scheduler.abort(s, f"error:{e!r}")
-            for s in work.seqs:
+            for s in seqs:
                 self._finalize(s)
             return
-        for i, (s, tok) in enumerate(zip(work.seqs, next_tokens)):
+        for i, (s, tok) in enumerate(zip(seqs, next_tokens)):
             if s.sampling.logprobs:
                 s.logprobs.append(self._logprob_of(
                     logits[i], tok, s.sampling.temperature))
         now = time.monotonic()
-        for s in work.seqs:
+        for s in seqs:
             s.note_phase("decode", now)  # step + its scheduling gap
         finished = []
         with self._lock:
-            for s, tok in zip(work.seqs, next_tokens):
+            for s, tok in zip(seqs, next_tokens):
                 s.token_versions.append(ver)
                 if self.scheduler.commit_token(s, tok):
                     finished.append(s)
-        for s, tok in zip(work.seqs, next_tokens):
+        for s, tok in zip(seqs, next_tokens):
             self._emit_token(s, tok, ver)
         self._note_tokens(len(next_tokens))
         for s in finished:
             self._finalize(s)
+
+    def _verify_one(self, seq: Sequence, draft: list[int],
+                    ver: int) -> None:
+        """One speculative step for one lane: a single verify dispatch
+        scores the frontier token plus the drafts, the acceptance rule
+        runs in-jit, and every returned token is already backed by KV —
+        commit them in order (stopping if the lane retires mid-run on
+        eos / max_tokens) and emit with explicit stream indices."""
+        sp = seq.sampling
+        t0 = time.perf_counter()
+        try:
+            tokens, logits = self.runner.verify(
+                seq.last_token, seq.pos - 1, draft, seq.table,
+                sp.temperature, sp.top_k, sp.top_p)
+        except Exception as e:  # noqa: BLE001
+            with self._lock:
+                self.scheduler.abort(seq, f"error:{e!r}")
+            self._finalize(seq)
+            return
+        self._m_verify_ms.observe(
+            (time.perf_counter() - t0) * 1e3, tags=self._m_tags)
+        n_acc = len(tokens) - 1
+        self._spec_proposed_total += len(draft)
+        self._spec_accepted_total += n_acc
+        self._m_spec_proposed.inc(len(draft), tags=self._m_tags)
+        if n_acc:
+            self._m_spec_accepted.inc(n_acc, tags=self._m_tags)
+        if len(draft) > n_acc:
+            self._m_spec_rejected.inc(len(draft) - n_acc,
+                                      tags=self._m_tags)
+        self._m_spec_ratio.set(
+            self._spec_accepted_total
+            / max(1, self._spec_proposed_total), tags=self._m_tags)
+        seq.note_phase("verify", time.monotonic())
+        committed: list[int] = []
+        done = False
+        with self._lock:
+            for i, tok in enumerate(tokens):
+                if sp.logprobs:
+                    seq.logprobs.append(self._logprob_of(
+                        logits[i], tok, sp.temperature))
+                seq.token_versions.append(ver)
+                committed.append(tok)
+                if self.scheduler.commit_token(seq, tok):
+                    done = True
+                    break
+        base = len(seq.generated) - len(committed)
+        for j, tok in enumerate(committed):
+            self._emit_token(seq, tok, ver, index=base + j)
+        self._note_tokens(len(committed))
+        if done:
+            self._finalize(seq)
 
     # ------------------------------------------------------------ output
 
@@ -496,16 +623,20 @@ class LLMEngine:
                           self.model_cfg.vocab_size)
 
     def _emit_token(self, seq: Sequence, token: int,
-                    version: int) -> None:
+                    version: int, index: int | None = None) -> None:
         """`version` is the step-stable weight version the caller read
         under `_step_lock` — required, so a token can never be tagged
-        from a concurrent swap's half-installed state."""
+        from a concurrent swap's half-installed state. `index` is the
+        token's stream position; None means "the latest" (single-token
+        commits) — speculative steps commit several tokens before
+        emitting and pass each one's index explicitly."""
         with self._lock:
             stream = self._streams.get(seq.seq_id)
         if stream is not None:
-            ev = {"token": int(token), "index": len(seq.generated) - 1}
+            idx = len(seq.generated) - 1 if index is None else index
+            ev = {"token": int(token), "index": idx}
             if seq.sampling.logprobs:
-                ev["logprob"] = seq.logprobs[-1]
+                ev["logprob"] = seq.logprobs[idx]
                 ev["weight_version"] = version
             stream._emit(ev)
 
@@ -525,9 +656,16 @@ class LLMEngine:
         e2e = now - seq.enqueued_at
         breakdown = {k: round(v, 6) for k, v in seq.phases.items()}
         breakdown["e2e"] = round(e2e, 6)
-        if len(seq.generated) > 1 and seq.phases.get("decode"):
+        # TPOT divides decode-side wall time over the tokens actually
+        # committed: speculative steps commit several tokens per verify
+        # dispatch, so both the verify phase and the full token count
+        # enter the quotient (one-token-per-dispatch was only ever true
+        # spec-off)
+        dec_s = seq.phases.get("decode", 0.0) + seq.phases.get(
+            "verify", 0.0)
+        if len(seq.generated) > 1 and dec_s > 0:
             self._m_slo_tpot.observe(
-                seq.phases["decode"] * 1e3 / (len(seq.generated) - 1),
+                dec_s * 1e3 / (len(seq.generated) - 1),
                 tags=self._m_tags)
         with self._lock:
             self._finished_requests += 1
@@ -563,7 +701,7 @@ class LLMEngine:
 
     # deterministic waterfall order for the laid-out request spans
     _PHASE_ORDER = ("queue", "prefix_match", "prefill", "preempt",
-                    "decode", "emit")
+                    "decode", "verify", "emit")
 
     def _record_request_spans(self, seq: Sequence, now: float) -> None:
         """Emit the request's waterfall as child spans: one parent
@@ -668,6 +806,9 @@ class LLMEngine:
             # per replica by util.state.llm_status()
             "phase_seconds": phase_totals,
             "finished_requests": finished,
+            "spec_proposed": self._spec_proposed_total,
+            "spec_accepted": self._spec_accepted_total,
+            "paged_attention": self.runner.use_paged_attention,
         })
         return d
 

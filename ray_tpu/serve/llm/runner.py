@@ -57,6 +57,13 @@ class ModelAdapter:
     chunk_fn: Callable
     rules_fn: Callable  # () -> PartitionRules
     kv_heads: Callable[[Any], int]
+    # paged-attention entry points (ops/paged_attention.py kernel in the
+    # attention core instead of dense gathered context); None => family
+    # has no paged path and the engine falls back to dense
+    # (params, toks, pos, k_pages, v_pages, tables, cfg, interpret) -> ...
+    decode_paged_fn: Callable | None = None
+    # (params, toks, start, k_pages, v_pages, table, cfg, interpret) -> ...
+    verify_paged_fn: Callable | None = None
 
 
 def adapters() -> dict[str, ModelAdapter]:
@@ -80,6 +87,8 @@ def adapters() -> dict[str, ModelAdapter]:
             chunk_fn=gpt2.gpt2_prefill_chunk_kv,
             rules_fn=gpt2.gpt2_partition_rules,
             kv_heads=lambda cfg: cfg.n_head,
+            decode_paged_fn=gpt2.gpt2_decode_paged_kv,
+            verify_paged_fn=gpt2.gpt2_verify_paged_kv,
         ),
         "llama": ModelAdapter(
             name="llama",
@@ -94,6 +103,8 @@ def adapters() -> dict[str, ModelAdapter]:
             chunk_fn=llama.llama_prefill_chunk_kv,
             rules_fn=llama.llama_partition_rules,
             kv_heads=lambda cfg: cfg.n_kv_head,
+            decode_paged_fn=llama.llama_decode_paged_kv,
+            verify_paged_fn=llama.llama_verify_paged_kv,
         ),
     }
 
@@ -151,6 +162,8 @@ class ModelRunner:
         prefill_chunk_size: int | None = None,
         mesh=None,
         sample_seed: int = 0,
+        num_draft_tokens: int = 0,
+        use_paged_attention: bool = False,
     ):
         self.adapter = adapter
         self.cfg = cfg
@@ -170,6 +183,17 @@ class ModelRunner:
         self.prefill_chunk_size = prefill_chunk_size
         self.max_blocks_per_seq = (
             max_model_len + block_size - 1) // block_size
+        # speculative verify: ONE program of static width K+1 (row 0 is
+        # the last committed token, rows 1..K the drafts) serves every
+        # accept/reject outcome — `n_draft` and `start` are traced
+        self.num_draft_tokens = num_draft_tokens
+        self.spec_width = num_draft_tokens + 1 if num_draft_tokens else 0
+        # paged attention only when the family provides the entry points
+        self.use_paged_attention = bool(
+            use_paged_attention and adapter.decode_paged_fn is not None
+            and adapter.verify_paged_fn is not None)
+        # pallas interpret mode off-TPU (CPU CI); real kernel on TPU
+        self._interpret = jax.default_backend() not in ("tpu", "axon")
 
         hk = adapter.kv_heads(cfg)
         hd = cfg.head_dim
@@ -207,6 +231,7 @@ class ModelRunner:
         self._prefill_jit = jax.jit(self._prefill_impl, donate_argnums=donate)
         self._decode_jit = jax.jit(self._decode_impl, donate_argnums=donate)
         self._chunk_jit = jax.jit(self._chunk_impl, donate_argnums=donate)
+        self._verify_jit = jax.jit(self._verify_impl, donate_argnums=donate)
         # pages are mutated functionally; serialize compute just in case
         # a stats probe races the step loop
         self._jit_lock = threading.Lock()
@@ -319,22 +344,83 @@ class ModelRunner:
         nxt = self._sample(last[None, :], temp, topk, topp, step)[0]
         return nxt, last, k_pages, v_pages
 
+    def _verify_impl(self, params, k_pages, v_pages, tokens, start,
+                     n_draft, block_ids, offsets, table, temps, topks,
+                     topps, step):
+        """Score a drafted run of ONE sequence in one dispatch and
+        accept/reject in-jit (no logits round-trip to host).
+
+        tokens (1, W) with W = num_draft_tokens + 1: row 0 is the last
+        committed token at traced position `start` (== pos - 1), rows
+        1..n_draft the proposer's guesses at start+1.., padded tail to
+        the static width. The program is the `prefill_chunk` shape —
+        context gathered through `table` for positions < start, causal
+        mask within the window — but samples EVERY window position and
+        applies the acceptance rule: keep drafts while draft[j] equals
+        the token the model itself samples at that position, then emit
+        the model's own correction token at the first mismatch (or the
+        bonus token after a full accept). K/V is scattered for all
+        window positions; slots past the accepted frontier are garbage
+        that stays masked (ctx covers only positions < start') and is
+        overwritten as the frontier advances — rollback is frontier
+        arithmetic, not data movement.
+
+        Returns (emitted (W,), n_acc scalar, logits (W, Vp), pages):
+        the caller commits emitted[:n_acc + 1]."""
+        L = self.cfg.n_layer
+        Bs = self.block_size
+        W = tokens.shape[1]
+        if self.use_paged_attention:
+            logits, k, v = self.adapter.verify_paged_fn(
+                params, tokens, start, k_pages, v_pages, table, self.cfg,
+                interpret=self._interpret)
+        else:
+            C = self.max_blocks_per_seq * Bs
+            k_ctx = k_pages[:, table]  # (L, MaxB, Bs, HK, D)
+            k_ctx = k_ctx.reshape(L, 1, C, *k_ctx.shape[3:])
+            v_ctx = v_pages[:, table]
+            v_ctx = v_ctx.reshape(L, 1, C, *v_ctx.shape[3:])
+            ctx_mask = (jnp.arange(C)[None, :] < start)  # (1, C)
+            chunk_mask = (jnp.arange(W)[None, :] <= n_draft)  # (1, W)
+            logits, k, v = self.adapter.chunk_fn(
+                params, tokens, start, k_ctx, v_ctx, ctx_mask,
+                chunk_mask, self.cfg)
+        k_pages = k_pages.at[:, block_ids, offsets].set(k[:, 0])
+        v_pages = v_pages.at[:, block_ids, offsets].set(v[:, 0])
+        lg = logits[0]  # (W, Vp)
+        target = self._sample(lg, temps, topks, topps, step)  # (W,)
+        # target[j] is the model's own token FOR position start+j+1;
+        # accept drafts while they match it, longest-prefix semantics
+        match = (target[:-1] == tokens[0, 1:]) \
+            & (jnp.arange(W - 1) < n_draft)
+        n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32)))
+        emitted = jnp.where(jnp.arange(W) <= n_acc, target, -1)
+        return emitted, n_acc, lg, k_pages, v_pages
+
     def _decode_impl(self, params, k_pages, v_pages, tokens, positions,
                      tables, temps, topks, topps, step):
         """tokens/positions/temps (Sb,); tables (Sb, max_blocks_per_seq).
         Gather pages -> dense context, run the model's decode step,
-        scatter the new K/V at each lane's position, sample."""
+        scatter the new K/V at each lane's position, sample. With
+        paged attention the gather disappears: the kernel indexes pages
+        in place through the block table."""
         L = self.cfg.n_layer
         S = tokens.shape[0]
         Bs = self.block_size
-        C = self.max_blocks_per_seq * Bs
-        k_ctx = k_pages[:, tables]  # (L, S, MaxB, Bs, HK, D)
-        k_ctx = k_ctx.reshape(L, S, C, *k_ctx.shape[4:])
-        v_ctx = v_pages[:, tables]
-        v_ctx = v_ctx.reshape(L, S, C, *v_ctx.shape[4:])
-        ctx_mask = jnp.arange(C)[None, :] < positions[:, None]
-        logits, k_new, v_new = self.adapter.decode_fn(
-            params, tokens, positions, k_ctx, v_ctx, ctx_mask, self.cfg)
+        if self.use_paged_attention:
+            logits, k_new, v_new = self.adapter.decode_paged_fn(
+                params, tokens, positions, k_pages, v_pages, tables,
+                self.cfg, interpret=self._interpret)
+        else:
+            C = self.max_blocks_per_seq * Bs
+            k_ctx = k_pages[:, tables]  # (L, S, MaxB, Bs, HK, D)
+            k_ctx = k_ctx.reshape(L, S, C, *k_ctx.shape[4:])
+            v_ctx = v_pages[:, tables]
+            v_ctx = v_ctx.reshape(L, S, C, *v_ctx.shape[4:])
+            ctx_mask = jnp.arange(C)[None, :] < positions[:, None]
+            logits, k_new, v_new = self.adapter.decode_fn(
+                params, tokens, positions, k_ctx, v_ctx, ctx_mask,
+                self.cfg)
         block_ids = jnp.take_along_axis(
             tables, (positions // Bs)[:, None], axis=1)[:, 0]
         offsets = positions % Bs
@@ -476,6 +562,61 @@ class ModelRunner:
         nxt = np.asarray(nxt)
         return [int(t) for t in nxt[:S]], np.asarray(logits)[:S]
 
+    def verify(self, token: int, pos: int, draft: Sequence[int],
+               table: Sequence[int], temperature: float,
+               top_k: int = 0, top_p: float = 1.0
+               ) -> tuple[list[int], np.ndarray]:
+        """Verify a drafted run for one sequence: one dispatch scores
+        `token` (at position pos, the frontier) plus up to
+        num_draft_tokens drafts at pos+1.., accepts the longest matching
+        prefix in-jit, and returns (committed tokens, their logits rows).
+        len(result[0]) is 1 (all rejected) .. len(draft)+1 (full accept
+        plus the bonus token); the KV for every committed token is
+        already in the pages when this returns."""
+        if not self.spec_width:
+            raise RuntimeError("runner built without num_draft_tokens")
+        n_draft = len(draft)
+        W = self.spec_width
+        if not 0 < n_draft < W:
+            raise ValueError(f"draft of {n_draft} tokens (max {W - 1})")
+        if pos + n_draft >= self.max_model_len:
+            raise ValueError(
+                f"drafted run past max_model_len: pos {pos} + "
+                f"{n_draft} drafts >= {self.max_model_len}")
+        toks = np.zeros((1, W), np.int32)
+        toks[0, 0] = token
+        toks[0, 1:1 + n_draft] = draft
+        tab = np.zeros((self.max_blocks_per_seq,), np.int32)
+        tab[:len(table)] = table
+        positions = pos + np.arange(W)
+        # padded tail rows write to the null page at in-range offsets
+        block_ids = np.where(np.arange(W) <= n_draft,
+                             tab[np.minimum(positions, self.max_model_len - 1)
+                                 // self.block_size],
+                             0).astype(np.int32)
+        offsets = np.asarray(positions % self.block_size, np.int32)
+        temps = np.full((W,), temperature, np.float32)
+        topks = np.full((W,), top_k, np.int32)
+        topps = np.full((W,), top_p, np.float32)
+        self._step_counter += 1
+        from ray_tpu.util.tracing import jit_cache_size
+
+        before = jit_cache_size(self._verify_jit)
+        t0 = time.perf_counter()
+        with self._mesh_ctx(), self._jit_lock:
+            emitted, n_acc, logits, self.k_pages, self.v_pages = \
+                self._verify_jit(
+                    self.params, self.k_pages, self.v_pages, toks,
+                    np.int32(pos), np.int32(n_draft), block_ids, offsets,
+                    tab, temps, topks, topps,
+                    np.int32(self._step_counter))
+        self._note_compile("verify", self._verify_jit, before,
+                           time.perf_counter() - t0)
+        n_em = int(n_acc) + 1
+        emitted = np.asarray(emitted)
+        return ([int(t) for t in emitted[:n_em]],
+                np.asarray(logits)[:n_em])
+
     def warmup(self) -> int:
         """Compile every (bucket, kind) program up front so no request
         ever pays a mid-stream XLA compile (the TPU serving idiom:
@@ -510,6 +651,10 @@ class ModelRunner:
             if s >= self.max_batch_size:
                 break
             s = min(s * 2, self.max_batch_size)
+        if self.spec_width:
+            # single fixed-width program: one warmup call covers every
+            # draft length (n_draft is traced)
+            self.verify(1, 0, [1], null_table, 0.0)
         return self.compiled_signatures()
 
     def set_params(self, params: Any) -> None:
@@ -558,6 +703,7 @@ class ModelRunner:
         try:
             return (self._prefill_jit._cache_size()
                     + self._chunk_jit._cache_size()
-                    + self._decode_jit._cache_size())
+                    + self._decode_jit._cache_size()
+                    + self._verify_jit._cache_size())
         except Exception:  # noqa: BLE001
             return -1

@@ -186,13 +186,17 @@ class DecodeWork:
 
 class Scheduler:
     def __init__(self, pool: BlockPool, *, max_batch_size: int,
-                 max_model_len: int, chunk_size: int = 0):
+                 max_model_len: int, chunk_size: int = 0,
+                 spec_tokens: int = 0):
         self.pool = pool
         self.max_batch_size = max_batch_size
         self.max_model_len = max_model_len
         # page-aligned by construction (the engine rounds it); 0 means
         # "whole prompt in one chunk" (monolithic prefill)
         self.chunk_size = chunk_size
+        # speculative decoding: opportunistically grow tables so a
+        # drafted run of up to spec_tokens extra KV slots fits (0 = off)
+        self.spec_tokens = spec_tokens
         self.waiting: deque[Sequence] = deque()
         self.running: list[Sequence] = []  # admission order (LIFO victim)
         self.preemption_count = 0
@@ -329,6 +333,23 @@ class Scheduler:
                 self.preempt(victim)
                 if victim is seq:
                     continue  # re-examine slot i (new occupant)
+        # speculative headroom is best-effort: a drafted run commits up
+        # to spec_tokens + 1 positions in one step, so try to cover
+        # pos + spec_tokens — but NEVER preempt for it; under pressure
+        # the engine just clamps the draft length to the pages owned
+        # and decode proceeds exactly as without spec
+        if self.spec_tokens:
+            for seq in self.running:
+                if seq.prefill_pending:
+                    continue
+                want = self.pool.blocks_for_tokens(
+                    min(seq.pos + self.spec_tokens, self.max_model_len))
+                if len(seq.table) < want:
+                    try:
+                        seq.table.extend(
+                            self.pool.alloc(want - len(seq.table)))
+                    except CacheExhausted:
+                        break
 
     def preempt(self, seq: Sequence) -> None:
         """Recompute-style: drop page refs, requeue at the FRONT so the

@@ -131,6 +131,26 @@ CATALOG: list[dict] = [
     {"name": "serve_llm_weight_swaps_total", "type": "counter",
      "where": "ray_tpu/serve/llm/engine.py",
      "what": "weight hot-swaps installed at a step boundary"},
+    {"name": "serve_llm_spec_proposed_total", "type": "counter",
+     "where": "ray_tpu/serve/llm/engine.py",
+     "what": "draft tokens proposed to the speculative verify program"},
+    {"name": "serve_llm_spec_accepted_total", "type": "counter",
+     "where": "ray_tpu/serve/llm/engine.py",
+     "what": "draft tokens accepted by the verify program"},
+    {"name": "serve_llm_spec_rejected_total", "type": "counter",
+     "where": "ray_tpu/serve/llm/engine.py",
+     "what": "draft tokens rejected by the verify program (the "
+             "spec-accept-collapse rule's miss side)"},
+    {"name": "serve_llm_spec_accept_ratio", "type": "gauge",
+     "where": "ray_tpu/serve/llm/engine.py",
+     "what": "cumulative accepted / proposed draft tokens"},
+    {"name": "serve_llm_verify_step_ms", "type": "histogram",
+     "where": "ray_tpu/serve/llm/engine.py",
+     "what": "speculative verify step latency (K+1-wide program)"},
+    {"name": "serve_llm_paged_attn_enabled", "type": "gauge",
+     "where": "ray_tpu/serve/llm/engine.py",
+     "what": "1 when decode/verify run the pallas paged-attention "
+             "kernel, 0 on the dense gather fallback"},
     # serve SLO attribution (the per-request waterfall's metric face)
     {"name": "serve_slo_ttft_ms", "type": "histogram",
      "where": "ray_tpu/serve/llm/engine.py",

@@ -436,6 +436,17 @@ def default_rules() -> list[WatchRule]:
                         "at >=50 pages/s — working set outgrew the "
                         "pool (thrash)"),
         WatchRule(
+            "spec-accept-collapse",
+            metric="serve_llm_spec_accepted_total",
+            stat="hit_ratio",
+            ratio_metric="serve_llm_spec_rejected_total",
+            op="<", threshold=0.2, min_rate=50.0, window_s=60,
+            for_s=20, severity="warning",
+            description="speculative accept ratio collapsed under 20% "
+                        "at >=50 proposed drafts/s — the proposer "
+                        "stopped predicting this workload; every "
+                        "verify step is wasted width"),
+        WatchRule(
             "train-straggler", metric="train_step_seconds",
             stat="skew", op=">", threshold=2.0, window_s=120,
             for_s=30, severity="warning",

@@ -1,0 +1,24 @@
+"""GL019 firing fixture: per-iteration device->host syncs in a step loop."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Engine:
+    def __init__(self, params):
+        self._decode_jit = jax.jit(lambda p, t: (t, t))
+        self._params = params
+
+    def _step_loop(self):
+        tokens = jnp.zeros((8,), jnp.int32)
+        while True:
+            logits, tokens = self._decode_jit(self._params, tokens)
+            tok = int(tokens[0])  # FIRE: cast of a device value
+            prob = logits.max().item()  # FIRE: .item() sync per step
+            host = np.asarray(logits)  # FIRE: asarray of device value
+            stats = jax.device_get(logits)  # FIRE: device_get in loop
+            self._emit(tok, prob, host, stats)
+
+    def _emit(self, *parts):
+        pass

@@ -52,6 +52,7 @@ RULE_CASES = [
     ("GL015", "wallclock-duration", "gl015_fire.py", "gl015_ok.py", 3),
     ("GL016", "bare-print", "gl016_fire.py", "gl016_ok.py", 3),
     ("GL018", "unbounded-accumulator", "gl018_fire.py", "gl018_ok.py", 3),
+    ("GL019", "host-sync-in-step-loop", "gl019_fire.py", "gl019_ok.py", 4),
 ]
 
 
@@ -74,7 +75,7 @@ def test_rule_catalog_complete():
     assert [c.code for c in catalog] == [
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
         "GL008", "GL009", "GL010", "GL011", "GL012", "GL013", "GL014",
-        "GL015", "GL016", "GL018"]
+        "GL015", "GL016", "GL018", "GL019"]
     for cls in catalog:
         assert cls.name and cls.description and cls.invariant
     index_catalog = index_rule_catalog()

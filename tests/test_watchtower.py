@@ -228,7 +228,8 @@ def test_default_rule_pack_covers_catalog_signals():
     rules = {r.name: r for r in default_rules()}
     assert {"serve-ttft-slo-burn", "serve-queue-ramp",
             "replica-flapping", "span-plane-overload",
-            "prefix-cache-thrash", "train-straggler",
+            "prefix-cache-thrash", "spec-accept-collapse",
+            "train-straggler",
             "train-stall", "train-pipeline-bubble", "log-error-spike",
             "task-queue-stall", "object-stranded-refs"} == set(rules)
     for r in rules.values():
