@@ -117,20 +117,116 @@ def _zero1_bench_subprocess() -> dict:
         return {}
 
 
+_ZERO_LADDER_SNIPPET = """
+import json, time, os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp, optax
+from ray_tpu.models.gpt2 import (GPT2Config, gpt2_loss,
+                                 gpt2_partition_rules, init_gpt2)
+from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+from ray_tpu.parallel.ops import collective_op_counts
+from ray_tpu.train.spmd import (batch_shardings, init_sharded_state,
+                                make_train_step, optimizer_state_bytes)
+
+cfg = GPT2Config.tiny()
+mesh = build_mesh(MeshSpec(data=8))
+rules = gpt2_partition_rules()
+tx = optax.adamw(3e-4, weight_decay=0.1)
+B, T, steps, warmup, accum = 16, 128, 4, 2, 2
+toks = jax.random.randint(jax.random.PRNGKey(1), (B, T + 1), 0,
+                          cfg.vocab_size, jnp.int32)
+batch = {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+batch = jax.device_put(batch, batch_shardings(mesh, batch))
+out = {"data_axis": 8, "batch": B, "seq": T, "accum_steps": accum}
+for stage in (0, 1, 2, 3):
+    state = init_sharded_state(
+        lambda: init_gpt2(jax.random.PRNGKey(0), cfg), tx, mesh, rules,
+        zero_stage=stage, accum_steps=accum)
+    step = make_train_step(lambda p, b: gpt2_loss(p, b, cfg), tx,
+                           zero_stage=stage, mesh=mesh, rules=rules,
+                           accum_steps=accum)
+    comp = {"opt_bytes": optimizer_state_bytes(state.opt_state),
+            "grad_bytes": optimizer_state_bytes(state.grad_accum),
+            "param_bytes": optimizer_state_bytes(state.params)}
+    with mesh:
+        for _ in range(warmup):
+            state, m = step(state, batch)
+        float(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step(state, batch)
+        loss = float(m["loss"])
+        dt = time.perf_counter() - t0
+        census = collective_op_counts(
+            step.jitted.lower(state, batch).compile().as_text())
+    out["stage%d" % stage] = {
+        "tokens_per_sec": round(B * T * steps / dt, 1),
+        "loss": round(loss, 6), "collectives": census, **comp}
+s0 = out["stage0"]
+out["ratios"] = {
+    "opt_bytes": round(
+        out["stage1"]["opt_bytes"] / max(1, s0["opt_bytes"]), 4),
+    "grad_bytes": round(
+        out["stage2"]["grad_bytes"] / max(1, s0["grad_bytes"]), 4),
+    "param_bytes": round(
+        out["stage3"]["param_bytes"] / max(1, s0["param_bytes"]), 4)}
+out["loss_delta_max"] = round(max(
+    abs(out["stage%d" % s]["loss"] - s0["loss"]) for s in (1, 2, 3)), 8)
+print(json.dumps(out))
+"""
+
+
+def _zero_ladder_bench_subprocess() -> dict:
+    """Full ZeRO ladder A/B on an 8-virtual-device CPU mesh: stages
+    0..3 of the same gpt2-tiny adamw step with accum_steps=2 (so the
+    grad-accum buffer exists at every stage and its bytes are
+    comparable), recording per-stage tokens/s, loss, the per-chip
+    bytes of each state component (optimizer / grad / param — the
+    1/8 rungs the test suite also gates), and the compiled collective
+    census (stage 3 adds the just-in-time param all-gathers). On TPU
+    hardware the same ladder runs inline at XL scale via
+    RAY_TPU_BENCH_ZERO_STAGE (see main())."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _ZERO_LADDER_SNIPPET],
+            capture_output=True, text=True, timeout=600,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        return _json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception:  # noqa: BLE001 - secondary scenario, best-effort
+        return {}
+
+
 def _pipeline_bench(num_stages: int = 2, num_microbatches: int = 8) -> dict:
-    """1F1B pipeline-strategy scenario: S stage workers, M microbatches
-    streamed through the object store. Records tokens/s, the measured
-    bubble ratio, and the (S-1)/(S-1+M) theoretical floor. NOTE on a
-    single-core host the S stage processes timeshare one core, so the
-    measured bubble reads CPU contention (~1 - 1/S), not schedule
-    shape — the schedule-level bubble is unit-test-gated exact in
-    tests/test_pipeline_strategy.py (see PERF_NOTES.md)."""
+    """1F1B pipeline-strategy scenario, flat vs interleaved at equal
+    S/M. Two lanes per schedule:
+
+    - real compute: tokens/s, step time, measured bubble. NOTE on a
+      single-core host the S stage processes timeshare one core, so
+      this bubble reads CPU contention, not schedule shape.
+    - schedule emulation (``emulate_ms``): ops are modeled fixed
+      latencies running through the real driver/actor/object-store
+      path; sleeping workers overlap even on one core, so THIS bubble
+      is the schedule-quality number, and the interleaved one must sit
+      strictly below flat (the `train-bubble-regression` gate in
+      tests/test_bench_report.py rides `emulated.interleaved_wins`).
+    """
     import numpy as np
 
     import ray_tpu
     from ray_tpu.cluster_utils import Cluster
     from ray_tpu.models.pipelined import PipelinedConfig
-    from ray_tpu.parallel.pipeline import theoretical_bubble
+    from ray_tpu.parallel.pipeline import (
+        theoretical_bubble,
+        theoretical_bubble_interleaved,
+    )
     from ray_tpu.train.pipeline_strategy import PipelineStrategy
 
     S, M = num_stages, num_microbatches
@@ -145,25 +241,54 @@ def _pipeline_bench(num_stages: int = 2, num_microbatches: int = 8) -> dict:
                 head_node_args={"num_cpus": max(4, S + 1)})
     c.wait_for_nodes()
     ray_tpu.init(address=c.address)
-    try:
+
+    def run(R, emulate_ms=None, steps=3, warmup=2):
         ps = PipelineStrategy(cfg, num_stages=S, num_microbatches=M,
-                              lr=1e-2)
-        first = ps.train_step(batch)  # compile warmup (fwd+bwd per stage)
-        ps.train_step(batch)
-        steps = 3
-        t0 = time.perf_counter()
-        ms = [ps.train_step(batch) for _ in range(steps)]
-        dt = time.perf_counter() - t0
-        ps.shutdown()
+                              lr=1e-2, num_repeats=R,
+                              emulate_ms=emulate_ms)
+        try:
+            first = ps.train_step(batch)  # compile warmup
+            for _ in range(warmup - 1):
+                ps.train_step(batch)
+            t0 = time.perf_counter()
+            ms = [ps.train_step(batch) for _ in range(steps)]
+            dt = time.perf_counter() - t0
+        finally:
+            ps.shutdown()
         bubbles = sorted(m["bubble_ratio"] for m in ms)
         return {
-            "stages": S, "microbatches": M, "batch": B, "seq": T,
             "tokens_per_sec": round(B * T * steps / dt, 1),
             "step_ms": round(1e3 * dt / steps, 1),
             "bubble_ratio": round(bubbles[len(bubbles) // 2], 4),
-            "bubble_theoretical": round(theoretical_bubble(S, M), 4),
             "loss_first": round(first["loss"], 4),
             "loss_last": round(ms[-1]["loss"], 4),
+        }
+
+    try:
+        flat = run(1)
+        inter = run(2)
+        emu_ms = (40.0, 80.0)  # modeled fwd/bwd per full stage
+        eflat = run(1, emulate_ms=emu_ms, warmup=1)
+        einter = run(2, emulate_ms=emu_ms, warmup=1)
+        return {
+            "stages": S, "microbatches": M, "batch": B, "seq": T,
+            **flat,
+            "bubble_theoretical": round(theoretical_bubble(S, M), 4),
+            "interleaved": {
+                **inter, "num_repeats": 2,
+                "bubble_theoretical": round(
+                    theoretical_bubble_interleaved(S, M, 2), 4),
+            },
+            "emulated": {
+                "op_ms": list(emu_ms),
+                "flat_bubble": eflat["bubble_ratio"],
+                "flat_theoretical": round(theoretical_bubble(S, M), 4),
+                "interleaved_bubble": einter["bubble_ratio"],
+                "interleaved_theoretical": round(
+                    theoretical_bubble_interleaved(S, M, 2), 4),
+                "interleaved_wins":
+                    einter["bubble_ratio"] < eflat["bubble_ratio"],
+            },
         }
     except Exception:  # noqa: BLE001 - secondary scenario, best-effort
         return {}
@@ -388,6 +513,7 @@ def main(trace: str | None = None, profile: bool = False):
     # params + 2 adam moments ≈ 8.5GB, fits one chip's HBM with remat.
     xl_per_chip, xl_mfu, xl_policy = 0.0, 0.0, ""
     z1_per_chip, z1_mfu, z1_batch, z1_bytes_ratio = 0.0, 0.0, 0, 0.0
+    z1_stage = 0
     if on_tpu:
         import os as _os
 
@@ -413,16 +539,21 @@ def main(trace: str | None = None, profile: bool = False):
         xl_mfu = 6.0 * xp * xl_per_chip / 197e12
         del xstate, xbatch
 
-        # ZeRO-1 sharded update on the same XL config (direction 4):
-        # moments shard 1/N over the data axis, and the freed HBM buys
-        # a larger per-chip batch — the default doubles it; tune with
-        # RAY_TPU_BENCH_ZERO1_BATCH.
+        # ZeRO sharded update on the same XL config (direction 4):
+        # optimizer state shards 1/N over the data axis (stage 1), and
+        # the freed HBM buys a larger per-chip batch — the default
+        # doubles it; tune with RAY_TPU_BENCH_ZERO1_BATCH. The ladder
+        # rung is a knob: RAY_TPU_BENCH_ZERO_STAGE=2 keeps grads
+        # resident reduce-scattered, =3 shards resident params with a
+        # just-in-time gather in the step.
         if n > 1:
+            z1_stage = int(_os.environ.get("RAY_TPU_BENCH_ZERO_STAGE",
+                                           "1"))
             z1_batch = int(_os.environ.get("RAY_TPU_BENCH_ZERO1_BATCH",
                                            str(2 * xB)))
             zstate = init_sharded_state(
                 lambda: init_gpt2(jax.random.PRNGKey(0), xcfg), tx,
-                mesh, rules, shard_optimizer=True)
+                mesh, rules, zero_stage=z1_stage)
             z1_bytes_ratio = (optimizer_state_bytes(zstate.opt_state)
                               / max(1, xl_opt_bytes))
             ztoks = jax.random.randint(
@@ -432,7 +563,7 @@ def main(trace: str | None = None, profile: bool = False):
             zbatch = jax.device_put(zbatch,
                                     batch_shardings(mesh, zbatch))
             zstep = make_train_step(lambda p, b: gpt2_loss(p, b, xcfg),
-                                    tx, shard_optimizer=True, mesh=mesh,
+                                    tx, zero_stage=z1_stage, mesh=mesh,
                                     rules=rules)
             zstate, _z1_loss, zdt, _ = _time_steps(
                 zstep, zstate, zbatch, mesh, 2, 10)
@@ -454,6 +585,7 @@ def main(trace: str | None = None, profile: bool = False):
     import os as _os2
 
     zero1 = {} if on_tpu else _zero1_bench_subprocess()
+    zero_ladder = {} if on_tpu else _zero_ladder_bench_subprocess()
     run_pipe = (not on_tpu) or _os2.environ.get(
         "RAY_TPU_BENCH_PIPELINE", "") == "1"
     pipeline = _pipeline_bench() if run_pipe else {}
@@ -515,8 +647,10 @@ def main(trace: str | None = None, profile: bool = False):
                         round(z1_per_chip, 1),
                     "gpt2_2048_zero1_mfu": round(z1_mfu, 3),
                     "gpt2_2048_zero1_batch": z1_batch,
+                    "gpt2_2048_zero_stage": z1_stage,
                     "zero1_opt_bytes_ratio": round(z1_bytes_ratio, 4),
                     "zero1": zero1,
+                    "zero_ladder": zero_ladder,
                     "pipeline": pipeline,
                     "ppo_env_steps_per_sec": round(ppo.get("median", 0.0)),
                     "ppo_env_steps_per_sec_stdev":

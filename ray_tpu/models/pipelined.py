@@ -169,6 +169,32 @@ def merge_pipeline_stages(stages: list[dict]) -> dict:
             "head": stages[-1]["head"]}
 
 
+def split_pipeline_stages_interleaved(params, cfg: PipelinedConfig,
+                                      num_stages: int, num_repeats: int
+                                      ) -> list[list[dict]]:
+    """Round-robin virtual-stage split for the interleaved MPMD
+    strategy: the model becomes V = S*R virtual chunks (contiguous
+    block runs, split exactly like `split_pipeline_stages(.., V)`), and
+    worker s owns chunks [s, s+S, .., s+(R-1)S] — result[s][r] is
+    virtual stage r*S + s. Chunk 0 carries embed/pos (it lives on
+    worker 0), chunk V-1 carries ln_f/head (worker S-1), so each chunk
+    is directly usable with `stage_apply(.., stage_idx=v,
+    num_stages=V, ..)`."""
+    V = num_stages * num_repeats
+    chunks = split_pipeline_stages(params, cfg, V)
+    return [[chunks[r * num_stages + s] for r in range(num_repeats)]
+            for s in range(num_stages)]
+
+
+def merge_pipeline_stages_interleaved(stage_chunks: list[list[dict]]
+                                      ) -> dict:
+    """Inverse of `split_pipeline_stages_interleaved`: reassemble the
+    full tree from per-worker chunk lists (checkpointing / parity)."""
+    S, R = len(stage_chunks), len(stage_chunks[0])
+    flat = [stage_chunks[v % S][v // S] for v in range(S * R)]
+    return merge_pipeline_stages(flat)
+
+
 def _local_mesh():
     """One-device mesh carrying the `fsdp` axis so `_block`'s ring
     attention resolves outside the hybrid-mesh program (size-1 ring ==
@@ -187,7 +213,10 @@ def stage_apply(cfg: PipelinedConfig, stage_params: dict, stage_idx: int,
     (under a size-1 fsdp shard_map), so chaining all stages reproduces
     the single-program loss bit-for-bit modulo float reassociation.
     Differentiable — the MPMD strategy takes jax.vjp of this per
-    microbatch."""
+    microbatch. A `mesh` carrying a `data` axis (the strategy's
+    intra-stage ZeRO data-parallel group) splits the microbatch over it
+    — block weights stay replicated (or ZeRO-resharded by the caller)
+    and GSPMD inserts the loss-mean reduction."""
     from ray_tpu.parallel.ops import shard_map as _shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -199,6 +228,7 @@ def stage_apply(cfg: PipelinedConfig, stage_params: dict, stage_idx: int,
     else:
         h = payload
     mesh = mesh if mesh is not None else _local_mesh()
+    bspec = P("data") if dict(mesh.shape).get("data", 1) > 1 else P()
 
     def body(blocks, hh):
         def one(carry, blk):
@@ -207,7 +237,7 @@ def stage_apply(cfg: PipelinedConfig, stage_params: dict, stage_idx: int,
         out, _ = jax.lax.scan(one, hh, blocks)
         return out
 
-    h = _shard_map(body, mesh, in_specs=(P(), P()), out_specs=P())(
+    h = _shard_map(body, mesh, in_specs=(P(), bspec), out_specs=bspec)(
         stage_params["blocks"], h)
     if not last:
         return h

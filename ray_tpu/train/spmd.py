@@ -7,17 +7,31 @@ NamedShardings from partition rules (fsdp/tensor axes), the batch is
 sharded over (data, fsdp), and GSPMD inserts the reduce-scatter /
 all-gather traffic that DDP/ZeRO would do by hand.
 
-ZeRO-1 (`shard_optimizer=True`): optimizer-state leaves are ALSO laid
-out sharded along the data axis ("Automatic Cross-Replica Sharding of
-Weight Update in Data-Parallel Training" — each replica owns 1/N of
-the moments), and the step becomes reduce-scatter(grads) → shard-local
-optax update → all-gather(params), expressed purely as sharding
+The ZeRO ladder (`zero_stage=0|1|2|3`; `shard_optimizer=True` is the
+back-compat spelling of stage 1): each rung shards one more
+param-shaped component 1/N along the data axis ("Automatic
+Cross-Replica Sharding of Weight Update in Data-Parallel Training" —
+each replica owns a shard instead of a copy), all expressed as sharding
 constraints inside the single jitted program so XLA schedules/overlaps
-the collectives itself. Per-chip optimizer bytes drop ~1/data-axis-size
-(see `optimizer_state_bytes`), which is headroom for a bigger per-chip
-batch. The math is identical — sharding is layout, not arithmetic — so
-loss tracks the replicated step exactly for elementwise-stable
-optimizers (sgd/momentum); adam-family optimizers amplify the ulp-level
+the collectives itself:
+
+- stage 1: optimizer state resident 1/N; the step becomes
+  reduce-scatter(grads) → shard-local optax update → all-gather(params);
+- stage 2: the gradient-accumulation buffer is ALSO resident 1/N —
+  grads are reduce-scattered once per microstep and accumulate in the
+  scattered layout between optimizer updates (`accum_steps`), so grad
+  bytes join the per-chip memory win;
+- stage 3: resident params are ALSO 1/N; the step all-gathers them
+  just-in-time inside the jitted program (the gather sits before the
+  loss, so XLA overlaps it with early forward compute) and new params
+  are written back scattered — no full copy ever lives in HBM.
+
+Per-chip bytes per component drop ~1/data-axis-size (see
+`optimizer_state_bytes` and the `train_{optimizer,grad,param}_state_bytes`
+gauges), which is headroom for a bigger per-chip batch. The math is
+identical — sharding is layout, not arithmetic — so loss tracks the
+replicated step exactly for elementwise-stable optimizers
+(sgd/momentum); adam-family optimizers amplify the ulp-level
 reduction-order differences between two differently-partitioned XLA
 programs through mu/sqrt(nu), so their trajectories track closely but
 not bitwise (see TRAINING.md "memory math & parity").
@@ -198,13 +212,20 @@ class TrainState:
     params: PyTree
     opt_state: PyTree
     step: jax.Array
+    # gradient-accumulation buffer (None unless accum_steps > 1): the
+    # param-shaped state that ZeRO stage 2 keeps resident reduce-
+    # scattered 1/N between optimizer updates
+    grad_accum: PyTree = None
 
     @staticmethod
-    def create(params: PyTree, tx: optax.GradientTransformation) -> "TrainState":
+    def create(params: PyTree, tx: optax.GradientTransformation,
+               grad_accum: bool = False) -> "TrainState":
         return TrainState(
             params=params,
             opt_state=tx.init(params),
             step=jnp.zeros((), jnp.int32),
+            grad_accum=(jax.tree.map(jnp.zeros_like, params)
+                        if grad_accum else None),
         )
 
 
@@ -219,12 +240,14 @@ def zero1_shardings(
     rules: PartitionRules, tree: PyTree, mesh: Mesh,
     data_axis: str = AXIS_DATA,
 ) -> PyTree:
-    """ZeRO-1 NamedShardings for a param-shaped tree: each leaf's rule
-    spec additionally sharded over `data_axis` on the first evenly-
+    """The raw +data-axis layout for a param-shaped tree: each leaf's
+    rule spec additionally sharded over `data_axis` on the first evenly-
     divisible dimension, so N data-parallel replicas each own a 1/N
     shard instead of a full copy. Leaves with no divisible dim (and
     scalars like optimizer step counts) stay on their rule layout.
-    Works on concrete arrays and abstract (eval_shape) trees alike."""
+    Works on concrete arrays and abstract (eval_shape) trees alike.
+    This is the layout every ZeRO rung applies to its component —
+    `zero_shardings` decides WHICH components get it per stage."""
     def one(path, leaf):
         spec = rules.spec_for(path_str(path), mesh)
         return NamedSharding(
@@ -233,9 +256,43 @@ def zero1_shardings(
     return jax.tree_util.tree_map_with_path(one, tree)
 
 
+# which ladder rung starts sharding each state component: stage >= rung
+# means the component lives resident in the 1/N +data-axis layout
+ZERO_LADDER = {"optimizer": 1, "grads": 2, "params": 3}
+
+
+def zero_shardings(
+    rules: PartitionRules, tree: PyTree, mesh: Mesh, stage: int,
+    component: str = "optimizer", data_axis: str = AXIS_DATA,
+) -> PyTree:
+    """Per-component ZeRO NamedShardings: the `component`
+    ("optimizer" | "grads" | "params") tree gets the +data-axis 1/N
+    layout (`zero1_shardings`) iff `stage` has reached its ladder rung
+    (optimizer: 1, grads: 2, params: 3), else its plain rule layout.
+    The single source of truth for what each zero_stage shards."""
+    if component not in ZERO_LADDER:
+        raise ValueError(f"unknown ZeRO component {component!r}; "
+                         f"expected one of {sorted(ZERO_LADDER)}")
+    if stage >= ZERO_LADDER[component]:
+        return zero1_shardings(rules, tree, mesh, data_axis)
+    return rules.shardings(tree, mesh)
+
+
+def _resolve_zero_stage(zero_stage: int | None,
+                        shard_optimizer: bool) -> int:
+    """`zero_stage=None` defers to the legacy `shard_optimizer` bool
+    (True == stage 1); an explicit stage wins over the bool."""
+    if zero_stage is None:
+        return 1 if shard_optimizer else 0
+    if zero_stage not in (0, 1, 2, 3):
+        raise ValueError(f"zero_stage must be 0|1|2|3, got {zero_stage}")
+    return int(zero_stage)
+
+
 def state_shardings(
     rules: PartitionRules, state: TrainState, mesh: Mesh,
     shard_optimizer: bool = False, data_axis: str = AXIS_DATA,
+    zero_stage: int | None = None,
 ) -> TrainState:
     """NamedShardings for a TrainState. Optimizer moments are param-shaped
     subtrees whose tree paths *end with* the parameter's own path (e.g.
@@ -243,29 +300,35 @@ def state_shardings(
     match with `re.search` — shard them identically to their parameter;
     scalar leaves (step counts) fall through to the replicated catch-all.
 
-    ``shard_optimizer=True`` lays the optimizer state out ZeRO-1 style:
-    every moment leaf gains the `data_axis` on its first evenly-divisible
-    dimension (see `zero1_shardings`), cutting per-chip optimizer bytes
-    ~1/axis-size. Params/batch layouts are unchanged — the train step
-    reshards at the update boundary via constraints."""
+    `zero_stage` picks the ladder rung (`shard_optimizer=True` is the
+    stage-1 spelling): stage >= 1 lays the optimizer state out 1/N along
+    `data_axis`, stage >= 2 also the grad-accumulation buffer (when the
+    state carries one), stage >= 3 also the resident params — each via
+    `zero_shardings`. The train step reshards at its boundaries via
+    constraints, so batch layouts are unchanged."""
+    stage = _resolve_zero_stage(zero_stage, shard_optimizer)
     return TrainState(
-        params=rules.shardings(state.params, mesh),
-        opt_state=(zero1_shardings(rules, state.opt_state, mesh, data_axis)
-                   if shard_optimizer
-                   else rules.shardings(state.opt_state, mesh)),
+        params=zero_shardings(rules, state.params, mesh, stage, "params",
+                              data_axis),
+        opt_state=zero_shardings(rules, state.opt_state, mesh, stage,
+                                 "optimizer", data_axis),
         step=NamedSharding(mesh, P()),
+        grad_accum=(None if state.grad_accum is None else
+                    zero_shardings(rules, state.grad_accum, mesh, stage,
+                                   "grads", data_axis)),
     )
 
 
-def optimizer_state_bytes(opt_state: PyTree) -> int:
-    """Worst-case per-device bytes resident for `opt_state`: for every
+def optimizer_state_bytes(tree: PyTree) -> int:
+    """Worst-case per-device bytes resident for a state tree: for every
     addressable device, sum the bytes of the shards it holds (a
     replicated leaf contributes its full size on every device; a
-    ZeRO-1-sharded leaf 1/N), and take the max. The number the
-    `train_optimizer_state_bytes` gauge reports and the sharded-update
-    memory-win assertion gates on."""
+    ZeRO-sharded leaf 1/N), and take the max. Named for its original
+    (optimizer-state) use but component-agnostic — the same measurement
+    backs the `train_{optimizer,grad,param}_state_bytes` gauges and the
+    sharded-layout memory-win assertions."""
     per_dev: dict = {}
-    for leaf in jax.tree_util.tree_leaves(opt_state):
+    for leaf in jax.tree_util.tree_leaves(tree):
         if isinstance(leaf, jax.Array):
             for sh in leaf.addressable_shards:
                 per_dev[sh.device] = per_dev.get(sh.device, 0) \
@@ -274,6 +337,8 @@ def optimizer_state_bytes(opt_state: PyTree) -> int:
 
 
 _opt_bytes_gauge = None
+_grad_bytes_gauge = None
+_param_bytes_gauge = None
 
 
 def _optimizer_bytes_gauge():
@@ -290,6 +355,36 @@ def _optimizer_bytes_gauge():
     return _opt_bytes_gauge
 
 
+def _grad_state_bytes_gauge():
+    global _grad_bytes_gauge
+    if _grad_bytes_gauge is None:
+        from ray_tpu.util.metrics import Gauge
+
+        _grad_bytes_gauge = Gauge(
+            "train_grad_state_bytes",
+            "Per-chip resident gradient-accumulation bytes (max over "
+            "addressable devices), tagged by layout=replicated|zero2 — "
+            "the ZeRO-2 memory win: grads live reduce-scattered 1/N "
+            "between accumulation steps",
+            tag_keys=("layout",))
+    return _grad_bytes_gauge
+
+
+def _param_state_bytes_gauge():
+    global _param_bytes_gauge
+    if _param_bytes_gauge is None:
+        from ray_tpu.util.metrics import Gauge
+
+        _param_bytes_gauge = Gauge(
+            "train_param_state_bytes",
+            "Per-chip resident parameter bytes (max over addressable "
+            "devices), tagged by layout=replicated|zero3 — the ZeRO-3 "
+            "memory win: params live 1/N and are all-gathered "
+            "just-in-time inside the jitted step",
+            tag_keys=("layout",))
+    return _param_bytes_gauge
+
+
 def make_train_step(
     loss_fn: Callable[[PyTree, PyTree], jax.Array],
     tx: optax.GradientTransformation,
@@ -298,6 +393,8 @@ def make_train_step(
     mesh: Mesh | None = None,
     rules: PartitionRules | None = None,
     data_axis: str = AXIS_DATA,
+    zero_stage: int | None = None,
+    accum_steps: int = 1,
 ) -> Callable[[TrainState, PyTree], tuple[TrainState, dict]]:
     """Build a jitted train step `(state, batch) -> (state, metrics)`.
 
@@ -306,51 +403,101 @@ def make_train_step(
     propagates it and GSPMD inserts the collectives. Call under
     `with mesh:` so in-model `constrain` calls resolve.
 
-    ``shard_optimizer=True`` (requires `mesh` + `rules`; pair with a
-    state from ``init_sharded_state(..., shard_optimizer=True)``) turns
-    the update into the ZeRO-1 shape inside the SAME jitted program:
-    grads are constrained first to their rule layout (pinning the
-    backward's partitioning so the math matches the replicated step)
-    and then to the ZeRO-1 layout (reduce-scatter down to each
-    replica's 1/N shard), the optax update runs on the shards, and the
-    new params are constrained back to the rule layout (all-gather).
+    ``zero_stage`` picks the ladder rung (requires `mesh` + `rules` for
+    stage >= 1; `shard_optimizer=True` is the stage-1 spelling; pair
+    with a state from ``init_sharded_state`` at the same stage). All
+    rungs live inside the SAME jitted program as sharding constraints:
+
+    - stage >= 1: grads are constrained first to their rule layout
+      (the pin: without it the sharded consumer back-propagates into
+      the backward GEMMs' partitioning and the grad arithmetic stops
+      matching the replicated step) and then to the 1/N layout
+      (reduce-scatter); the optax update runs on shards.
+    - stage >= 2 (+ ``accum_steps`` > 1): the scattered grads
+      accumulate into `state.grad_accum`, which stays resident 1/N
+      between optimizer updates — the update fires every accum_steps
+      microsteps on the mean, then the buffer resets to zeros.
+    - stage >= 3: `state.params` arrive resident 1/N; the step
+      constrains them to the rule layout BEFORE the loss (the same
+      double-constraint pin, now as a just-in-time all-gather placed
+      where XLA can overlap it with early forward compute) and writes
+      new params back scattered. Stages 1-2 instead gather new params
+      back to the rule layout after the update.
+
     XLA sees one program and overlaps the resharding collectives with
-    backward compute; on XLA:CPU the partitioner realizes the
-    scatter as allreduce+slice, on TPU as a true reduce-scatter."""
-    if shard_optimizer and (mesh is None or rules is None):
-        raise ValueError("shard_optimizer=True needs mesh= and rules= "
-                         "to derive the ZeRO-1 layouts")
+    compute; on XLA:CPU the partitioner realizes the scatter as
+    allreduce+slice, on TPU as a true reduce-scatter.
+
+    ``accum_steps`` composes with every stage (stage 0 accumulates in
+    the rule layout): `state.step` counts microsteps, and the loss
+    reported each call is the microbatch loss."""
+    stage = _resolve_zero_stage(zero_stage, shard_optimizer)
+    if stage >= 1 and (mesh is None or rules is None):
+        raise ValueError(f"zero_stage={stage} needs mesh= and rules= "
+                         "to derive the ZeRO layouts")
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
     def _constrain(tree: PyTree, shardings: PyTree) -> PyTree:
         return jax.tree.map(jax.lax.with_sharding_constraint, tree,
                             shardings)
 
+    def _zero(t):
+        return _constrain(t, zero1_shardings(rules, t, mesh, data_axis))
+
     def step(state: TrainState, batch: PyTree):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        if stage >= 3:
+            # just-in-time all-gather of the 1/N-resident params,
+            # pinned to the rule layout so the forward/backward
+            # partitioning matches the replicated program exactly
+            params_full = _constrain(state.params,
+                                     rules.shardings(state.params, mesh))
+        else:
+            params_full = state.params
+        loss, grads = jax.value_and_grad(loss_fn)(params_full, batch)
         gnorm = optax.global_norm(grads)
-        if shard_optimizer:
-            # full-layout pin, THEN the ZeRO-1 reshard: without the
+        if stage >= 1:
+            # full-layout pin, THEN the ZeRO reshard: without the
             # intermediate constraint the sharded consumer back-
             # propagates into the backward GEMMs' partitioning and the
             # grad arithmetic stops matching the replicated step
             grads = _constrain(grads, rules.shardings(grads, mesh))
+            grads = _zero(grads)
+            params_s = (state.params if stage >= 3
+                        else _zero(state.params))
+        else:
+            params_s = state.params
+        if accum_steps > 1:
+            # accumulate in the resident layout (1/N for stage >= 2);
+            # the update is computed every microstep and selected in on
+            # the boundary — shape/sharding-stable, no lax.cond, and
+            # with jnp.where the non-boundary cost is the update math
+            # on already-materialized shards
+            acc = jax.tree.map(jnp.add, state.grad_accum, grads)
+            boundary = (state.step + 1) % accum_steps == 0
+            mean = jax.tree.map(lambda a: a / accum_steps, acc)
+            updates, opt_u = tx.update(mean, state.opt_state, params_s)
+            params_u = optax.apply_updates(params_s, updates)
 
-            def z1(t):
-                return _constrain(
-                    t, zero1_shardings(rules, t, mesh, data_axis))
+            def sel(a, b):
+                return jnp.where(boundary, a, b)
 
-            grads = z1(grads)
-            params_s = z1(state.params)
+            new_params = jax.tree.map(sel, params_u, params_s)
+            new_opt = jax.tree.map(sel, opt_u, state.opt_state)
+            new_accum = jax.tree.map(
+                lambda a: jnp.where(boundary, jnp.zeros_like(a), a), acc)
+        else:
             updates, new_opt = tx.update(grads, state.opt_state, params_s)
             new_params = optax.apply_updates(params_s, updates)
+            new_accum = state.grad_accum
+        if stage in (1, 2):
             new_params = _constrain(new_params,
                                     rules.shardings(new_params, mesh))
-        else:
-            updates, new_opt = tx.update(grads, state.opt_state,
-                                         state.params)
-            new_params = optax.apply_updates(state.params, updates)
+        elif stage >= 3:
+            new_params = _zero(new_params)  # stays resident 1/N
         new_state = TrainState(
-            params=new_params, opt_state=new_opt, step=state.step + 1
+            params=new_params, opt_state=new_opt, step=state.step + 1,
+            grad_accum=new_accum,
         )
         return new_state, {"loss": loss, "grad_norm": gnorm}
 
@@ -380,6 +527,16 @@ def make_train_step(
         boundaries=(0.0001, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5,
                     30),
         tag_keys=("phase",))
+    m_gather_share = None
+    if stage >= 3:
+        from ray_tpu.util.metrics import Gauge
+
+        m_gather_share = Gauge(
+            "train_zero_gather_share",
+            "Fraction of step time spent in host-observed all_gather "
+            "collectives while zero_stage >= 3 — the ZeRO-3 "
+            "param-gather tax; input of the train-zero-gather-stall "
+            "watchtower rule. Populated while step attribution is on.")
 
     def _attributed_step(state: TrainState, batch: PyTree):
         """Waterfall-mode step: wall-to-wall phase attribution. Adds a
@@ -437,6 +594,8 @@ def make_train_step(
         phases = {"data_wait": data_wait, "h2d": t1 - t0, "host": gap}
         for op, v in coll_by_op.items():
             phases[f"collective.{op}"] = v
+        if m_gather_share is not None and dt > 0.0:
+            m_gather_share.set(coll_by_op.get("all_gather", 0.0) / dt)
         phases["compile" if compiled else "compute"] = dt - coll
         if not compiled:
             m_step.observe(dt)
@@ -484,25 +643,38 @@ def init_sharded_state(
     rules: PartitionRules,
     shard_optimizer: bool = False,
     data_axis: str = AXIS_DATA,
+    zero_stage: int | None = None,
+    accum_steps: int = 1,
 ) -> TrainState:
     """Initialize a TrainState directly into its sharded layout: the init
     is jitted with out_shardings so every shard is materialized on its
     owning device — no host-memory full copy (crucial for models larger
-    than one chip's HBM). ``shard_optimizer=True`` materializes the
-    optimizer state in its ZeRO-1 layout from the start (each replica
-    holds only its 1/data-axis shard) and reports the resulting
-    per-chip bytes on the `train_optimizer_state_bytes` gauge."""
+    than one chip's HBM). ``zero_stage`` (or the legacy
+    ``shard_optimizer=True`` == stage 1) materializes each ladder
+    component in its 1/N layout from the start — optimizer state
+    (stage >= 1), the grad-accumulation buffer when ``accum_steps > 1``
+    (stage >= 2), resident params (stage >= 3) — and reports the
+    per-chip bytes on the `train_optimizer_state_bytes` /
+    `train_grad_state_bytes` / `train_param_state_bytes` gauges."""
+    stage = _resolve_zero_stage(zero_stage, shard_optimizer)
 
     def make():
         params = init_fn()
-        return TrainState.create(params, tx)
+        return TrainState.create(params, tx, grad_accum=accum_steps > 1)
 
     abstract = jax.eval_shape(make)
-    shardings = state_shardings(rules, abstract, mesh, shard_optimizer,
-                                data_axis)
+    shardings = state_shardings(rules, abstract, mesh,
+                                data_axis=data_axis, zero_stage=stage)
     with mesh:
         state = jax.jit(make, out_shardings=shardings)()
     _optimizer_bytes_gauge().set(
         float(optimizer_state_bytes(state.opt_state)),
-        tags={"layout": "zero1" if shard_optimizer else "replicated"})
+        tags={"layout": "zero1" if stage >= 1 else "replicated"})
+    _param_state_bytes_gauge().set(
+        float(optimizer_state_bytes(state.params)),
+        tags={"layout": "zero3" if stage >= 3 else "replicated"})
+    if state.grad_accum is not None:
+        _grad_state_bytes_gauge().set(
+            float(optimizer_state_bytes(state.grad_accum)),
+            tags={"layout": "zero2" if stage >= 2 else "replicated"})
     return state

@@ -172,8 +172,13 @@ class JaxTrainer:
         schedule per step (train/pipeline_strategy.py). Config keys in
         train_loop_config: `model` (PipelinedConfig kwargs), `batch`
         ({tokens, targets} numpy), `steps`, `num_stages` (default:
-        scaling_config.num_workers), `num_microbatches`, `lr`,
-        `seed`."""
+        scaling_config.num_workers), `num_microbatches`, `lr`, `seed`,
+        plus the interleaved/ZeRO composition knobs `num_repeats`,
+        `zero_stage`, `data_parallel`, `momentum`. Stage workers
+        checkpoint their param shards through the CheckpointManager
+        every `checkpoint_frequency` steps (the manager reassembles a
+        restore-compatible full state via
+        `load_pipeline_checkpoint`)."""
         from ray_tpu.train.pipeline_strategy import PipelineStrategy
 
         cfg = dict(self._config or {})
@@ -185,6 +190,9 @@ class JaxTrainer:
             os.path.expanduser("~"), "ray_tpu_results")
         exp_dir = os.path.join(storage, name)
         os.makedirs(exp_dir, exist_ok=True)
+        ckpt_cfg = (self.run_config.checkpoint_config
+                    or CheckpointConfig())
+        manager = CheckpointManager(exp_dir, ckpt_cfg)
         sc = self.scaling_config
         ps = PipelineStrategy(
             cfg.get("model") or {},
@@ -194,15 +202,26 @@ class JaxTrainer:
             seed=cfg.get("seed", 0),
             resources_per_worker=sc.resources_per_worker,
             placement_strategy=sc.placement_strategy,
+            num_repeats=int(cfg.get("num_repeats", 1)),
+            zero_stage=int(cfg.get("zero_stage", 0)),
+            data_parallel=int(cfg.get("data_parallel", 1)),
+            momentum=float(cfg.get("momentum", 0.0)),
         )
         from ray_tpu import dashboard as _dash
 
         history: list[dict] = []
+        last_ckpt: Checkpoint | None = None
         try:
-            for step in range(int(cfg.get("steps", 1))):
+            steps = int(cfg.get("steps", 1))
+            freq = max(1, int(ckpt_cfg.checkpoint_frequency or 1))
+            for step in range(steps):
                 metrics = ps.train_step(cfg["batch"])
                 metrics["step"] = step
                 history.append(metrics)
+                if (step + 1) % freq == 0 or step == steps - 1:
+                    staged = ps.save_checkpoint(
+                        os.path.join(exp_dir, f"staging_{step:06d}"))
+                    last_ckpt = manager.register(staged, metrics)
                 _dash.publish_view("train", name, {
                     "status": "RUNNING", "iteration": len(history),
                     "num_workers": ps.num_stages, "metrics": metrics})
@@ -220,7 +239,7 @@ class JaxTrainer:
         finally:
             ps.shutdown()
         return Result(metrics=history[-1] if history else {},
-                      checkpoint=None, path=exp_dir,
+                      checkpoint=last_ckpt, path=exp_dir,
                       metrics_history=history)
 
     def _fit_spmd(self) -> Result:

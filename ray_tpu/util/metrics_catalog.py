@@ -43,9 +43,25 @@ CATALOG: list[dict] = [
      "where": "ray_tpu/train/spmd.py",
      "what": "per-chip optimizer-state bytes, by layout "
              "(replicated|zero1) — the ZeRO-1 memory win"},
+    {"name": "train_grad_state_bytes", "type": "gauge",
+     "where": "ray_tpu/train/spmd.py",
+     "what": "per-chip resident grad-accum bytes, by layout "
+             "(replicated|zero2) — the ZeRO-2 memory win"},
+    {"name": "train_param_state_bytes", "type": "gauge",
+     "where": "ray_tpu/train/spmd.py",
+     "what": "per-chip resident param bytes, by layout "
+             "(replicated|zero3) — the ZeRO-3 memory win"},
+    {"name": "train_zero_gather_share", "type": "gauge",
+     "where": "ray_tpu/train/spmd.py",
+     "what": "all-gather share of train step time at zero_stage >= 3 "
+             "(attribution runs) — the JIT param-gather cost"},
     {"name": "train_pipeline_bubble_ratio", "type": "gauge",
      "where": "ray_tpu/train/pipeline_strategy.py",
      "what": "measured 1F1B bubble fraction of the last pipeline step"},
+    {"name": "train_pipeline_virtual_stages", "type": "gauge",
+     "where": "ray_tpu/train/pipeline_strategy.py",
+     "what": "virtual stages (stages x repeats) of the running "
+             "pipeline — > stages means interleaved 1F1B is active"},
     {"name": "train_microbatches_total", "type": "counter",
      "where": "ray_tpu/train/pipeline_strategy.py",
      "what": "microbatches executed by the pipeline train strategy"},

@@ -471,6 +471,19 @@ def default_rules() -> list[WatchRule]:
                         "bubble=(S-1)/(S-1+M)) or a stage is a "
                         "straggler"),
         WatchRule(
+            "train-zero-gather-stall",
+            metric="train_zero_gather_share",
+            stat="last", agg="max", op=">",
+            threshold=float(os.environ.get(
+                "RAY_TPU_WATCHTOWER_GATHER_SHARE", "0.35")),
+            window_s=60, for_s=30, severity="warning",
+            description="all-gather share of the train step over "
+                        "RAY_TPU_WATCHTOWER_GATHER_SHARE (default "
+                        "0.35) sustained 30s with zero_stage >= 3 — "
+                        "the just-in-time param gather dominates the "
+                        "step; drop to stage 2 or widen the per-chip "
+                        "batch to amortize it"),
+        WatchRule(
             "log-error-spike", metric="log_records_total",
             kind="rate", agg="sum", labels={"level": "error"},
             op=">", threshold=float(os.environ.get(

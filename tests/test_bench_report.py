@@ -45,6 +45,33 @@ def test_index_has_every_artifact_and_primary_metric():
             assert r["record"]["metric"] in text
 
 
+def test_train_bubble_regression():
+    """The interleaved-1F1B perf claim, gated on the committed bench
+    artifact: in the newest round carrying the pipeline schedule-
+    emulation A/B, the interleaved measured bubble must sit strictly
+    below flat at equal S/M. (The emulated lane models op latency
+    through the real driver/actor path, so the comparison is immune to
+    single-core CPU contention — see bench.py `_pipeline_bench`.)"""
+    check = bench_report.bubble_regression(ROOT)
+    assert check is not None, (
+        "no bench round records the pipeline emulation A/B — rerun "
+        "`python bench.py` and commit the new BENCH_r<N>.json")
+    assert check["ok"], (
+        f"interleaved bubble regressed: {check['interleaved']} >= "
+        f"{check['flat']} (flat) in {check['source']}")
+    # the index surfaces the same verdict
+    assert "Interleaved below flat (emulated lane): yes" in \
+        bench_report.build_index(ROOT)
+
+
+def test_zero_ladder_indexed():
+    """The newest round's ZeRO ladder renders into the index with its
+    byte-ratio summary — the bytes-win trajectory stays readable."""
+    text = bench_report.build_index(ROOT)
+    assert "## ZeRO ladder" in text
+    assert "Sharded/replicated byte ratios" in text
+
+
 def test_committed_index_matches_regeneration():
     committed = os.path.join(ROOT, "BENCH_INDEX.md")
     assert os.path.exists(committed), (

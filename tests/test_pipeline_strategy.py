@@ -10,10 +10,13 @@ import pytest
 
 import ray_tpu
 from ray_tpu.parallel.pipeline import (
+    interleaved_1f1b_submission_order,
     one_f_one_b_schedule,
     one_f_one_b_submission_order,
     simulate_1f1b,
+    simulate_interleaved_1f1b,
     theoretical_bubble,
+    theoretical_bubble_interleaved,
 )
 
 
@@ -87,6 +90,55 @@ def test_simulated_bubble_matches_theoretical(S, M):
     # unequal op costs still fill: bubble stays below the equal-cost
     # GPipe worst case of (S-1)/M utilization loss at these shapes
     assert 0.0 <= simulate_1f1b(S, M, 1.0, 2.0)["bubble_ratio"] < 1.0
+
+
+# ------------------------------------------- interleaved schedule math
+
+
+@pytest.mark.parametrize("S,M,R", [(2, 4, 2), (2, 2, 3), (3, 6, 2),
+                                   (4, 8, 2), (2, 8, 4)])
+def test_interleaved_submission_complete_and_topological(S, M, R):
+    """Every (kind, virtual_stage, microbatch) appears once, and each
+    op's dependencies precede it — FIFO workers realize the schedule."""
+    order = interleaved_1f1b_submission_order(S, M, R)
+    V = S * R
+    assert len(order) == 2 * V * M
+    assert sorted(order) == sorted(
+        [("fwd", v, m) for v in range(V) for m in range(M)]
+        + [("bwd", v, m) for v in range(V) for m in range(M)])
+    seen = set()
+    for kind, v, m in order:
+        if kind == "fwd" and v > 0:
+            assert ("fwd", v - 1, m) in seen, (kind, v, m)
+        if kind == "bwd":
+            assert ("fwd", v, m) in seen, (kind, v, m)
+            if v < V - 1:
+                assert ("bwd", v + 1, m) in seen, (kind, v, m)
+        seen.add((kind, v, m))
+
+
+def test_interleaved_submission_rejects_m_below_s():
+    with pytest.raises(ValueError):
+        interleaved_1f1b_submission_order(4, 3, 2)
+    with pytest.raises(ValueError):
+        interleaved_1f1b_submission_order(2, 4, 0)
+
+
+@pytest.mark.parametrize("S,M,R", [(2, 4, 2), (2, 4, 3), (3, 6, 2),
+                                   (4, 8, 2), (4, 4, 4)])
+def test_interleaved_sim_matches_theory_and_beats_flat(S, M, R):
+    """The discrete-event interleaved makespan reproduces the
+    (S-1)/(R*M+S-1) floor exactly, strictly below flat 1F1B's
+    (S-1)/(M+S-1) at equal S and M — the whole point of V virtual
+    stages per worker."""
+    sim = simulate_interleaved_1f1b(S, M, R)
+    assert sim["bubble_ratio"] == pytest.approx(
+        theoretical_bubble_interleaved(S, M, R), abs=1e-9)
+    flat = simulate_1f1b(S, M)["bubble_ratio"]
+    assert sim["bubble_ratio"] < flat, (sim, flat)
+    # R=1 degrades to the flat schedule
+    assert simulate_interleaved_1f1b(S, M, 1)["bubble_ratio"] == \
+        pytest.approx(flat, abs=1e-9)
 
 
 # ------------------------------------------------------- cluster parity
@@ -175,12 +227,15 @@ def test_pipeline_metrics_surface(cluster):
     ps = PipelineStrategy(cfg, num_stages=2, num_microbatches=2,
                           lr=1e-2)
     try:
-        m_bubble, m_micro = _strategy_metrics()
+        m_bubble, m_micro, m_virtual = _strategy_metrics()
         before = m_micro._values.get((), 0.0)
         out = ps.train_step(_toy_batch(cfg, B=4))
         assert m_micro._values.get((), 0.0) == before + 2
         exposed = "\n".join(m_bubble.expose())
         assert "train_pipeline_bubble_ratio" in exposed
+        exposed_v = "\n".join(m_virtual.expose())
+        assert "train_pipeline_virtual_stages" in exposed_v
+        assert m_virtual._values.get((), 0.0) == 2.0  # flat: V == S
         assert out["loss"] > 0
     finally:
         ps.shutdown()
@@ -223,3 +278,213 @@ def test_pipeline_strategy_rejects_bad_shapes(cluster):
             ps.train_step(_toy_batch(cfg, B=4))  # 4 % 3 != 0
     finally:
         ps.shutdown()
+
+
+# --------------------------------------- interleaved + ZeRO composition
+
+
+def _single_program_reference(cfg, batch, steps, lr=1e-2, seed=0):
+    """pipelined_train_step on a 1-device mesh — the parity oracle all
+    strategy configurations (flat, interleaved, composed) must match."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from ray_tpu.models.pipelined import (
+        init_pipelined,
+        pipelined_train_step,
+    )
+
+    params = init_pipelined(jax.random.PRNGKey(seed), cfg)
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1),
+                ("pipe", "fsdp"))
+    step = pipelined_train_step(cfg, mesh, lr=lr)
+    jb = {k: jnp.asarray(v) for k, v in batch.items()}
+    losses = []
+    for _ in range(steps):
+        params, loss = step(params, jb)
+        losses.append(float(loss))
+    return params, losses
+
+
+def test_interleaved_strategy_matches_single_program(cluster):
+    """num_repeats=2 (V=4 virtual stages on 2 workers): the circular
+    schedule must be numerically invisible — same losses and merged
+    params as the single-program oracle, and the metrics surface the
+    interleaved theoretical floor."""
+    import jax
+
+    from ray_tpu.models.pipelined import PipelinedConfig
+    from ray_tpu.train.pipeline_strategy import PipelineStrategy
+
+    cfg = PipelinedConfig()
+    batch = _toy_batch(cfg, B=8, seed=2)
+    ref_params, ref_losses = _single_program_reference(cfg, batch, 3)
+
+    ps = PipelineStrategy(cfg, num_stages=2, num_microbatches=4,
+                          lr=1e-2, seed=0, num_repeats=2)
+    try:
+        metrics = [ps.train_step(batch) for _ in range(3)]
+        np.testing.assert_allclose(
+            ref_losses, [m["loss"] for m in metrics], atol=1e-5)
+        merged = ps.full_params()
+        for a, b in zip(jax.tree.leaves(ref_params),
+                        jax.tree.leaves(merged)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5)
+        for m in metrics:
+            assert m["num_repeats"] == 2
+            assert m["virtual_stages"] == 4
+            assert m["bubble_theoretical"] == pytest.approx(
+                theoretical_bubble_interleaved(2, 4, 2))
+    finally:
+        ps.shutdown()
+
+
+def test_pipeline_zero_composition_parity_and_bytes(cluster):
+    """One config, every axis: interleaved (R=2) pipeline with
+    intra-stage ZeRO over data_parallel=2. Losses must still match the
+    single-program oracle (ZeRO is a memory layout, not an algorithm
+    change), and the per-stage resident grad/param bytes must land at
+    ~1/D of the undistributed run's."""
+    from ray_tpu.models.pipelined import PipelinedConfig
+    from ray_tpu.train.pipeline_strategy import PipelineStrategy
+
+    cfg = PipelinedConfig()
+    batch = _toy_batch(cfg, B=8, seed=4)
+    _, ref_losses = _single_program_reference(cfg, batch, 3)
+
+    def run(zero_stage, data_parallel):
+        ps = PipelineStrategy(cfg, num_stages=2, num_microbatches=4,
+                              lr=1e-2, seed=0, num_repeats=2,
+                              zero_stage=zero_stage,
+                              data_parallel=data_parallel,
+                              momentum=0.9)
+        try:
+            losses = [ps.train_step(batch)["loss"] for _ in range(3)]
+            return losses, ps.last_stage_stats
+        finally:
+            ps.shutdown()
+
+    base_losses, base_stats = run(0, 1)
+    z_losses, z_stats = run(3, 2)
+    # momentum=0.9 diverges from the momentum-0 oracle — compare the
+    # two momentum runs to each other, and the first (pre-update) loss
+    # to the oracle's
+    assert z_losses[0] == pytest.approx(ref_losses[0], abs=1e-5)
+    np.testing.assert_allclose(base_losses, z_losses, atol=1e-5)
+    D, bound = 2, 1.25 / 2
+    for b, z in zip(base_stats, z_stats):
+        assert z["grad_state_bytes"] / b["grad_state_bytes"] <= bound
+        assert z["param_state_bytes"] / b["param_state_bytes"] <= bound
+        assert z["velocity_state_bytes"] / b["velocity_state_bytes"] \
+            <= bound
+
+
+def test_emulated_bubble_interleaved_below_flat(cluster):
+    """The measured-bubble gate: in schedule-emulation mode (modeled op
+    latency through the real submission/actor/accounting path — immune
+    to single-core contention), interleaved R=2 must measure a strictly
+    smaller bubble than flat at equal S and M."""
+    from ray_tpu.models.pipelined import PipelinedConfig
+    from ray_tpu.train.pipeline_strategy import PipelineStrategy
+
+    cfg = PipelinedConfig(d_model=32, d_ff=64, block_size=16)
+    batch = _toy_batch(cfg, B=8)
+
+    def measure(R):
+        # op times large vs dispatch overhead so a loaded CI box can't
+        # blur the schedule-shape difference into the noise
+        ps = PipelineStrategy(cfg, num_stages=2, num_microbatches=4,
+                              lr=1e-2, seed=0, num_repeats=R,
+                              emulate_ms=(60.0, 120.0))
+        try:
+            ps.train_step(batch)  # warm the dispatch path
+            return np.mean([ps.train_step(batch)["bubble_ratio"]
+                            for _ in range(3)])
+        finally:
+            ps.shutdown()
+
+    flat, inter = measure(1), measure(2)
+    assert inter < flat, (inter, flat)
+    # both sit at/above their theoretical floors (sanity on the lane)
+    assert flat > theoretical_bubble(2, 4) - 1e-6
+    assert inter > theoretical_bubble_interleaved(2, 4, 2) - 1e-6
+
+
+# ----------------------------------------------------------- checkpoint
+
+
+def test_pipeline_checkpoint_round_trip(cluster, tmp_path):
+    """save_checkpoint writes per-stage shards + manifest;
+    load_pipeline_checkpoint reassembles the exact full param tree."""
+    import jax
+
+    from ray_tpu.models.pipelined import PipelinedConfig
+    from ray_tpu.train.pipeline_strategy import (
+        PipelineStrategy,
+        load_pipeline_checkpoint,
+    )
+
+    cfg = PipelinedConfig(d_model=32, d_ff=64, block_size=16)
+    ps = PipelineStrategy(cfg, num_stages=2, num_microbatches=2,
+                          lr=1e-2, seed=0, num_repeats=2)
+    try:
+        ps.train_step(_toy_batch(cfg, B=4))
+        ckpt = ps.save_checkpoint(str(tmp_path / "ck"))
+        want = ps.full_params()
+    finally:
+        ps.shutdown()
+    got, meta = load_pipeline_checkpoint(ckpt.path)
+    assert meta["format"] == "pipeline-stage-shards-v1"
+    assert meta["num_stages"] == 2 and meta["num_repeats"] == 2
+    for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # restore path: a fresh strategy seeded from the checkpoint params
+    # continues from the same weights
+    ps2 = PipelineStrategy(cfg, num_stages=2, num_microbatches=2,
+                           lr=1e-2, params=got)
+    try:
+        for a, b in zip(jax.tree.leaves(got),
+                        jax.tree.leaves(ps2.full_params())):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        ps2.shutdown()
+
+
+def test_jax_trainer_pipeline_checkpoints(cluster, tmp_path):
+    """JaxTrainer(strategy='pipeline') registers stage-shard
+    checkpoints through CheckpointManager and hands back the latest."""
+    from ray_tpu.train import (
+        CheckpointConfig,
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+    )
+    from ray_tpu.train.pipeline_strategy import load_pipeline_checkpoint
+
+    cfg_kwargs = dict(n_virtual_stages=4, d_model=32, d_ff=64,
+                      block_size=16, num_microbatches=2)
+    from ray_tpu.models.pipelined import PipelinedConfig
+
+    batch = _toy_batch(PipelinedConfig(**cfg_kwargs), B=4)
+    result = JaxTrainer(
+        strategy="pipeline",
+        train_loop_config={"model": cfg_kwargs, "batch": batch,
+                           "steps": 2, "num_stages": 2,
+                           "num_repeats": 2, "lr": 1e-2},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            name="pipe_ck", storage_path=str(tmp_path),
+            checkpoint_config=CheckpointConfig(num_to_keep=1)),
+    ).fit()
+    assert result.checkpoint is not None
+    params, meta = load_pipeline_checkpoint(result.checkpoint.path)
+    assert meta["num_repeats"] == 2
+    assert jax_leaf_count(params) > 0
+
+
+def jax_leaf_count(tree):
+    import jax
+
+    return len(jax.tree.leaves(tree))

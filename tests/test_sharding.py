@@ -43,3 +43,60 @@ def test_shard_pytree(cpu_mesh8):
     assert sharded["layer"]["bias"].sharding.spec == P("tensor")
     # round-trips values
     assert jnp.allclose(jax.device_get(k), 1.0)
+
+
+# ---------------------------------------------- add_axis_to_spec edges
+# The ZeRO ladder's "+replica axis" transformation (zero_shardings in
+# train/spmd.py maps it over whole state trees): documented edge cases.
+
+def test_add_axis_scalar_leaf_unchanged(cpu_mesh8):
+    from ray_tpu.parallel.sharding import add_axis_to_spec
+
+    assert add_axis_to_spec(P(), (), cpu_mesh8, "data") == P()
+
+
+def test_add_axis_no_divisible_dim_falls_back_replicated(cpu_mesh8):
+    """No dim divides by the shard count -> the leaf stays replicated
+    over the new axis (the caller's ~1/N byte assertions carry slack
+    for exactly these leaves)."""
+    from ray_tpu.parallel.sharding import add_axis_to_spec
+
+    assert add_axis_to_spec(P(), (3, 5), cpu_mesh8, "data") == P()
+
+
+def test_add_axis_already_sharded_on_axis_skipped(cpu_mesh8):
+    """A leaf already touching the axis comes back unchanged — mapping
+    zero_shardings over an already-ZeRO tree is idempotent."""
+    from ray_tpu.parallel.sharding import add_axis_to_spec
+
+    assert add_axis_to_spec(P("data"), (8, 8), cpu_mesh8, "data") \
+        == P("data")
+    assert add_axis_to_spec(P(("fsdp", "data")), (8, 8), cpu_mesh8,
+                            "data") == P(("fsdp", "data"))
+
+
+def test_add_axis_picks_first_evenly_divisible_dim(cpu_mesh8):
+    from ray_tpu.parallel.sharding import add_axis_to_spec
+
+    # dim0 (3) does not divide by data=2; dim1 (8) does
+    assert add_axis_to_spec(P(), (3, 8), cpu_mesh8, "data") \
+        == P(None, "data")
+
+
+def test_add_axis_composes_with_existing_axes(cpu_mesh8):
+    """Divisibility accounts for shards already on the dim: a
+    tensor(2)-sharded dim of 8 takes data(2) too (8 % 4 == 0), a dim
+    of 6 does not (6 % 4 != 0) and stays as-is."""
+    from ray_tpu.parallel.sharding import add_axis_to_spec
+
+    assert add_axis_to_spec(P("tensor"), (8, 4), cpu_mesh8, "data") \
+        == P(("tensor", "data"), None)
+    assert add_axis_to_spec(P("tensor"), (6,), cpu_mesh8, "data") \
+        == P("tensor")
+
+
+def test_add_axis_absent_mesh_axis_is_noop(cpu_mesh8):
+    from ray_tpu.parallel.sharding import add_axis_to_spec
+
+    assert add_axis_to_spec(P(), (8, 8), cpu_mesh8, "nonexistent") \
+        == P()

@@ -230,7 +230,8 @@ def test_default_rule_pack_covers_catalog_signals():
             "replica-flapping", "span-plane-overload",
             "prefix-cache-thrash", "spec-accept-collapse",
             "train-straggler",
-            "train-stall", "train-pipeline-bubble", "log-error-spike",
+            "train-stall", "train-pipeline-bubble",
+            "train-zero-gather-stall", "log-error-spike",
             "task-queue-stall", "object-stranded-refs"} == set(rules)
     for r in rules.values():
         assert r.severity in ("info", "warning", "critical")
@@ -316,6 +317,34 @@ def test_task_queue_stall_rule_fires_and_resolves():
     # pending at 30s, firing once held for_s=60, resolved when the
     # fast burst drags the windowed p99 under the threshold
     assert states == ["-", "pending", "pending", "firing", "-", "-"]
+    d = wt.alerts_dict()
+    assert [(e["from"], e["to"]) for e in d["history"]] == [
+        (None, "pending"), ("pending", "firing"),
+        ("firing", "resolved")]
+
+
+def test_zero_gather_stall_rule_fires_and_resolves():
+    """The ZeRO-3 rule: all-gather share of the train step held over
+    the threshold for 30s fires a warning (the JIT param gathers are
+    eating the step — drop to stage 2 or widen the data axis); the
+    share falling back under resolves it. Driven synthetically from
+    the train_zero_gather_share gauge."""
+    rule = {r.name: r for r in default_rules()}["train-zero-gather-stall"]
+    assert rule.severity == "warning"
+    assert rule.metric == "train_zero_gather_share"
+    cur = {"v": 0.0}
+    wt = Watchtower(lambda: f"train_zero_gather_share {cur['v']}\n",
+                    period_s=0, rules=[rule])
+    states = []
+    # healthy -> gather-bound (0.6 > 0.35) -> recovered; 15s ticks so
+    # for_s=30 holds the pending state for two ticks before firing
+    for t, v in enumerate([0.1, 0.6, 0.6, 0.6, 0.6, 0.1, 0.1]):
+        cur["v"] = float(v)
+        wt.sample_once(now=float(t * 15))
+        active = wt.alerts_dict(include_history=False)["alerts"]
+        states.append(active[0]["state"] if active else "-")
+    assert states == ["-", "pending", "pending", "firing", "firing",
+                      "-", "-"]
     d = wt.alerts_dict()
     assert [(e["from"], e["to"]) for e in d["history"]] == [
         (None, "pending"), ("pending", "firing"),
